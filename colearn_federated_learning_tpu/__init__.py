@@ -1,0 +1,27 @@
+"""colearn_federated_learning_tpu — a TPU-native federated-learning framework.
+
+A from-scratch rebuild of the capabilities of
+``aferaudo/CoLearn_Federated_Learning`` (PySyft/PyTorch/MQTT federated
+learning for IoT edge networks) re-designed TPU-first on JAX/XLA:
+
+- every federated round executes on-device: clients are simulated by
+  ``jax.vmap`` (single chip) or laid out along a ``jax.sharding.Mesh``
+  "clients" axis via ``shard_map`` (multi chip),
+- local SGD is a single jit-compiled ``lax.scan`` per client per round,
+- FedAvg/FedProx aggregation lowers to ``jax.lax.psum`` over ICI instead of
+  host-side tensor copies,
+- DP-noise and secure-aggregation masking hooks run on-device,
+- the MQTT/websocket control plane of the reference is replaced by
+  in-process orchestration (fed/engine.py owns enrollment-equivalent
+  client placement; a cross-process TCP control plane lives in ``comm/``
+  once that subsystem lands).
+
+NOTE ON PROVENANCE: the read-only reference checkout at /root/reference was
+empty during both the survey and build sessions (see SURVEY.md status
+banner), so reference parity claims cite SURVEY.md sections and
+BASELINE.json keys rather than reference file:line.
+"""
+
+__version__ = "0.1.0"
+
+from colearn_federated_learning_tpu.utils import config as config  # noqa: F401

@@ -1,0 +1,12 @@
+"""Data layer: dataset registry, non-IID partitioning, client shard packing."""
+
+from colearn_federated_learning_tpu.data.partition import (  # noqa: F401
+    dirichlet_partition,
+    iid_partition,
+    partition_counts,
+)
+from colearn_federated_learning_tpu.data.registry import get_dataset  # noqa: F401
+from colearn_federated_learning_tpu.data.sharding import (  # noqa: F401
+    ClientShards,
+    pack_client_shards,
+)

@@ -1,0 +1,3 @@
+"""Federated round engine: local training, server strategies, orchestration."""
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner  # noqa: F401

@@ -1,0 +1,432 @@
+"""Federated round orchestration, fully on-device.
+
+This replaces the reference's coordinator process (SURVEY.md §3a: MQTT
+enrollment → websocket broadcast → per-worker PyTorch epochs → host-side
+``fed_avg``) with a single jit-compiled round function:
+
+- single chip: clients are a ``vmap`` axis,
+- multi chip:  clients are a ``shard_map`` axis over a ``jax.sharding.Mesh``
+  and the weighted average lowers to ``jax.lax.psum`` over ICI
+  (BASELINE.json ``north_star``).
+
+One call = one federated round: cohort sampling → broadcast (implicit: the
+global params are an operand) → local SGD per client → privacy hooks →
+weighted aggregation → server update.  Shapes are static across rounds, so
+the program compiles once.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from colearn_federated_learning_tpu.data import registry as data_registry
+from colearn_federated_learning_tpu.data import partition as partition_lib
+from colearn_federated_learning_tpu.data.sharding import (
+    ClientShards,
+    pack_client_shards,
+    pad_clients_to_multiple,
+)
+from colearn_federated_learning_tpu.fed import local as local_lib
+from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu.models import registry as model_registry
+from colearn_federated_learning_tpu.privacy import dp as dp_lib
+from colearn_federated_learning_tpu.privacy import secure_agg as sa_lib
+from colearn_federated_learning_tpu.utils import prng, pytrees
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+def _resolve_devices(backend: str) -> list:
+    """Device list for --backend=auto|cpu|tpu (auto prefers accelerators)."""
+    devices = jax.devices()
+    if backend == "cpu":
+        devices = [d for d in devices if d.platform == "cpu"] or jax.devices("cpu")
+    elif backend == "tpu":
+        tpu = [d for d in devices if d.platform not in ("cpu",)]
+        if not tpu:
+            raise RuntimeError("--backend=tpu requested but no accelerator present")
+        devices = tpu
+    elif backend != "auto":
+        raise ValueError(f"unknown backend {backend!r} (use auto|cpu|tpu)")
+    return devices
+
+
+class FederatedLearner:
+    """End-to-end federated experiment: data, model, round loop, eval.
+
+    ``mesh``: optional ``jax.sharding.Mesh`` with a single axis (named by
+    ``config.run.mesh_axis``); when given, client state is sharded along it
+    and aggregation runs as psum over the mesh.  When None, everything runs
+    on one device via vmap.
+    """
+
+    @classmethod
+    def from_config(
+        cls,
+        config: ExperimentConfig,
+        dataset: Optional[data_registry.Dataset] = None,
+    ) -> "FederatedLearner":
+        """Build a learner honoring ``config.run.backend`` (the CLI's
+        ``--backend=tpu|cpu|auto``, BASELINE.json ``north_star``): resolve
+        devices, and if more than one is visible, lay clients over a
+        1-D mesh automatically."""
+        devices = _resolve_devices(config.run.backend)
+        mesh = None
+        if len(devices) > 1:
+            mesh = Mesh(np.array(devices), (config.run.mesh_axis,))
+        return cls(config, dataset=dataset, mesh=mesh)
+
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        dataset: Optional[data_registry.Dataset] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.config = config
+        self.mesh = mesh
+        c = config
+
+        # --- data -----------------------------------------------------
+        self.dataset = dataset or data_registry.get_dataset(
+            c.data.dataset, seed=c.run.seed
+        )
+        labels = np.asarray(self.dataset.y_train)
+        if c.data.partition == "dirichlet":
+            parts = partition_lib.dirichlet_partition(
+                labels, c.data.num_clients, c.data.dirichlet_alpha, seed=c.run.seed
+            )
+        else:
+            parts = partition_lib.iid_partition(
+                len(labels), c.data.num_clients, seed=c.run.seed
+            )
+        shards = pack_client_shards(
+            np.asarray(self.dataset.x_train), labels, parts,
+            capacity=c.data.max_examples_per_client,
+        )
+        self.real_num_clients = shards.num_clients
+        if mesh is not None:
+            shards = pad_clients_to_multiple(shards, mesh.devices.size)
+            # Interleave so real clients spread evenly across devices (ghost
+            # padding would otherwise pile onto the last devices and starve
+            # their per-device cohorts).  ``client_ids[slot]`` is the
+            # ORIGINAL client identity of each array slot; all PRNG is keyed
+            # on it, keeping results placement-independent.
+            D = mesh.devices.size
+            L = shards.num_clients // D
+            order = np.array(
+                [j * D + d for d in range(D) for j in range(L)], dtype=np.int32
+            )
+            shards = ClientShards(
+                x=shards.x[order], y=shards.y[order], counts=shards.counts[order]
+            )
+            self.client_ids = order
+        else:
+            self.client_ids = np.arange(shards.num_clients, dtype=np.int32)
+        self.shards = shards
+        self.num_clients = shards.num_clients
+
+        # --- model ----------------------------------------------------
+        self.model = model_registry.build_model(c.model)
+        example_x = jnp.asarray(shards.x[0, : c.fed.batch_size])
+        ikey = prng.init_key(prng.experiment_key(c.run.seed))
+        self.params = model_registry.init_params(self.model, example_x, ikey)
+        self.server_state = strategies.init_server_state(self.params, c.fed)
+
+        # --- local trainer -------------------------------------------
+        if c.fed.local_steps > 0:
+            self.num_steps = c.fed.local_steps
+        else:
+            steps_per_epoch = max(1, int(np.ceil(shards.capacity / c.fed.batch_size)))
+            self.num_steps = c.fed.local_epochs * steps_per_epoch
+        self.optimizer = local_lib.make_optimizer(c.fed.lr, c.fed.momentum)
+        self.local_update = local_lib.make_local_update(
+            self.model.apply,
+            self.optimizer,
+            num_steps=self.num_steps,
+            batch_size=c.fed.batch_size,
+            prox_mu=c.fed.prox_mu if c.fed.strategy == "fedprox" else 0.0,
+            min_steps_fraction=c.fed.straggler_min_fraction,
+        )
+
+        # --- cohort ---------------------------------------------------
+        cohort = c.fed.cohort_size or self.num_clients
+        self.cohort_size = min(cohort, self.num_clients)
+        if mesh is not None:
+            d = mesh.devices.size
+            # per-device cohort must be equal and static
+            self.cohort_per_device = max(1, self.cohort_size // d)
+            self.cohort_size = self.cohort_per_device * d
+        # DP noise accounting divides by the number of REAL clients expected
+        # to contribute (ghost padding never contributes).  If stragglers
+        # drop mid-round the realized central noise is below nominal — a
+        # known property of DP-FedAvg with dropouts; see privacy/dp.py.
+        self.dp_cohort = min(self.cohort_size, self.real_num_clients)
+
+        # --- compiled programs ---------------------------------------
+        self.base_key = prng.experiment_key(c.run.seed)
+        self._round_fn = self._build_round_fn()
+        self._eval_fn = self._build_eval_fn()
+        self._device_data = self._place_data()
+        self.history: list[dict] = []
+
+    # ------------------------------------------------------------------
+    # data placement
+    # ------------------------------------------------------------------
+    def _place_data(self):
+        x = jnp.asarray(self.shards.x)
+        y = jnp.asarray(self.shards.y)
+        counts = jnp.asarray(self.shards.counts)
+        ids = jnp.asarray(self.client_ids)
+        if self.mesh is not None:
+            ax = self.config.run.mesh_axis
+            sh = NamedSharding(self.mesh, P(ax))
+            x, y, counts, ids = (jax.device_put(a, sh) for a in (x, y, counts, ids))
+        return (x, y, counts, ids)
+
+    # ------------------------------------------------------------------
+    # one round, single-device (vmap over the cohort)
+    # ------------------------------------------------------------------
+    def _cohort_step(self, params, local_ids, global_ids, mask_cohort_ids,
+                     x, y, counts, key, round_idx):
+        """Shared per-cohort logic: local training + privacy + weighting.
+
+        ``local_ids`` index into the (possibly per-device) ``x/y/counts``
+        blocks; ``global_ids`` are the mesh-wide client identities used for
+        PRNG derivation, so results are bit-identical regardless of how
+        clients are placed on devices.  ``mask_cohort_ids`` is the FULL
+        round cohort (all devices) that secure-agg masks pair against.
+        Returns (weighted_delta_sum, total_weight, metrics) so the caller
+        can finish aggregation either locally (vmap path) or with a psum
+        (shard_map path).
+        """
+        c = self.config.fed
+        cx = jnp.take(x, local_ids, axis=0)
+        cy = jnp.take(y, local_ids, axis=0)
+        ccounts = jnp.take(counts, local_ids, axis=0)
+
+        # Per-(client, round) keys: placement-independent determinism.
+        keys = jax.vmap(lambda i: prng.client_round_key(key, i, round_idx))(global_ids)
+
+        # Straggler simulation: each cohort slot draws a per-CLIENT budget
+        # (keyed on global id, so placement-independent).
+        if c.straggler_prob > 0.0:
+            skey = prng.straggler_key(key, round_idx)
+
+            def budget_for(i):
+                k = jax.random.fold_in(skey, i)
+                slow = jax.random.bernoulli(k, c.straggler_prob)
+                frac = jax.random.uniform(jax.random.fold_in(k, 1))
+                return jnp.where(
+                    slow, (frac * self.num_steps).astype(jnp.int32), self.num_steps
+                )
+
+            budgets = jax.vmap(budget_for)(global_ids)
+        else:
+            budgets = jnp.full((self.cohort_size_local,), self.num_steps, jnp.int32)
+
+        results = jax.vmap(self.local_update, in_axes=(None, 0, 0, 0, 0, 0))(
+            params, cx, cy, ccounts, keys, budgets
+        )
+        deltas = results.delta
+        completed = results.completed
+
+        uniform_weights = c.dp_clip > 0.0 or c.secure_agg
+        if c.dp_clip > 0.0:
+            dp_keys = jax.vmap(lambda i: prng.dp_key(key, i, round_idx))(global_ids)
+            deltas = jax.vmap(
+                lambda d, k: dp_lib.clip_and_noise(
+                    d, c.dp_clip, c.dp_noise_multiplier, self.dp_cohort, k
+                )
+            )(deltas, dp_keys)
+
+        nonghost = (results.num_examples > 0)
+        if uniform_weights:
+            weights = (completed & nonghost).astype(jnp.float32)
+        else:
+            weights = results.num_examples.astype(jnp.float32) * (completed & nonghost)
+
+        if c.secure_agg:
+            # Clients pre-scale by their weight, then add pairwise masks;
+            # masks cancel in the plain SUM over the cohort.  Masks pair
+            # GLOBAL ids, so cancellation holds across devices too (the
+            # final sum is the psum over the mesh).
+            wdeltas = jax.vmap(lambda d, w: pytrees.tree_scale(d, w))(deltas, weights)
+            masked = jax.vmap(
+                lambda d, i: sa_lib.mask_update(d, key, i, mask_cohort_ids, round_idx)
+            )(wdeltas, global_ids)
+            wsum = jax.tree.map(lambda l: jnp.sum(l, axis=0), masked)
+        else:
+            wsum = pytrees.tree_weighted_sum(deltas, weights)
+
+        total_w = jnp.sum(weights)
+        loss_sum = jnp.sum(results.mean_loss * weights)
+        # "completed" reports real contributors only (ghost padding slots
+        # always finish their budget but never contribute).
+        n_completed = jnp.sum((completed & nonghost).astype(jnp.int32))
+        return wsum, total_w, (loss_sum, n_completed)
+
+    def _build_round_fn(self):
+        c = self.config.fed
+        ax = self.config.run.mesh_axis
+
+        if self.mesh is None:
+            self.cohort_size_local = self.cohort_size
+
+            @jax.jit
+            def round_fn(server_state, key, round_idx, x, y, counts, ids):
+                skey = prng.sampling_key(key, round_idx)
+                if self.cohort_size < self.num_clients:
+                    # Uniform sample WITHOUT replacement among real clients:
+                    # ghosts (count 0) are pushed to the end of the ranking
+                    # and only picked if the cohort exceeds real clients.
+                    scores = jax.random.uniform(skey, (self.num_clients,))
+                    scores = scores + (counts == 0) * 1e3
+                    sel = jnp.argsort(scores)[: self.cohort_size]
+                else:
+                    sel = jnp.arange(self.num_clients)
+                cohort_global = jnp.take(ids, sel)
+                wsum, total_w, (loss_sum, n_comp) = self._cohort_step(
+                    server_state.params, sel, cohort_global, cohort_global,
+                    x, y, counts, key, round_idx
+                )
+                denom = jnp.maximum(total_w, 1e-12)
+                mean_delta = pytrees.tree_scale(wsum, 1.0 / denom)
+                new_state = strategies.server_update(server_state, mean_delta, c)
+                metrics = {
+                    "train_loss": loss_sum / denom,
+                    "completed": n_comp,
+                    "total_weight": total_w,
+                }
+                return new_state, metrics
+
+            return round_fn
+
+        # ---- multi-chip: shard_map over the client axis --------------
+        mesh = self.mesh
+        self.cohort_size_local = self.cohort_per_device
+        local_clients = self.num_clients // mesh.devices.size
+
+        def body(server_state, key, round_idx, x_blk, y_blk, counts_blk, ids_blk):
+            dev = jax.lax.axis_index(ax)
+            skey = jax.random.fold_in(prng.sampling_key(key, round_idx), dev)
+            if self.cohort_per_device < local_clients:
+                # Sample this device's slice of the cohort among its REAL
+                # clients (interleaved placement spreads reals evenly, so
+                # ghosts are only picked when the cohort exceeds them).
+                scores = jax.random.uniform(skey, (local_clients,))
+                scores = scores + (counts_blk == 0) * 1e3
+                sel = jnp.argsort(scores)[: self.cohort_per_device]
+            else:
+                sel = jnp.arange(local_clients)
+            cohort_global = jnp.take(ids_blk, sel)
+            # Secure-agg masks pair against the FULL mesh-wide cohort: a
+            # cheap all_gather of the (cohort_per_device,) id vectors.
+            mask_cohort = jax.lax.all_gather(cohort_global, ax).reshape(-1)
+            wsum, total_w, (loss_sum, n_comp) = self._cohort_step(
+                server_state.params, sel, cohort_global, mask_cohort,
+                x_blk, y_blk, counts_blk, key, round_idx
+            )
+            # FedAvg across the pod: one psum over ICI per leaf.
+            wsum = jax.tree.map(lambda l: jax.lax.psum(l, ax), wsum)
+            total_w = jax.lax.psum(total_w, ax)
+            loss_sum = jax.lax.psum(loss_sum, ax)
+            n_comp = jax.lax.psum(n_comp, ax)
+            denom = jnp.maximum(total_w, 1e-12)
+            mean_delta = pytrees.tree_scale(wsum, 1.0 / denom)
+            new_state = strategies.server_update(server_state, mean_delta, c)
+            metrics = {
+                "train_loss": loss_sum / denom,
+                "completed": n_comp,
+                "total_weight": total_w,
+            }
+            return new_state, metrics
+
+        sharded = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(ax), P(ax), P(ax), P(ax)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    # ------------------------------------------------------------------
+    # evaluation (held-out global test set, SURVEY.md §3d)
+    # ------------------------------------------------------------------
+    def _build_eval_fn(self):
+        batch = max(self.config.fed.batch_size, 64)
+        x_test = np.asarray(self.dataset.x_test)
+        y_test = np.asarray(self.dataset.y_test)
+        n = len(x_test)
+        n_batches = int(np.ceil(n / batch))
+        pad = n_batches * batch - n
+        x_pad = np.concatenate([x_test, np.zeros((pad,) + x_test.shape[1:], x_test.dtype)])
+        y_pad = np.concatenate([y_test, np.zeros((pad,), y_test.dtype)])
+        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        xb = jnp.asarray(x_pad.reshape((n_batches, batch) + x_test.shape[1:]))
+        yb = jnp.asarray(y_pad.reshape((n_batches, batch)))
+        mb = jnp.asarray(mask.reshape((n_batches, batch)))
+        apply_fn = self.model.apply
+
+        @jax.jit
+        def eval_fn(params):
+            def step(carry, inp):
+                x, y, m = inp
+                logits = apply_fn({"params": params}, x, train=False)
+                ce = jax.nn.log_softmax(logits.astype(jnp.float32))
+                nll = -jnp.take_along_axis(ce, y[:, None], axis=1)[:, 0]
+                correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+                loss_sum, acc_sum, m_sum = carry
+                return (
+                    loss_sum + jnp.sum(nll * m),
+                    acc_sum + jnp.sum(correct * m),
+                    m_sum + jnp.sum(m),
+                ), None
+
+            (loss_sum, acc_sum, m_sum), _ = jax.lax.scan(
+                step, (0.0, 0.0, 0.0), (xb, yb, mb)
+            )
+            return loss_sum / m_sum, acc_sum / m_sum
+
+        return eval_fn
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def run_round(self) -> dict:
+        r = len(self.history)
+        self.server_state, metrics = self._round_fn(
+            self.server_state,
+            self.base_key,
+            jnp.asarray(r, jnp.int32),
+            *self._device_data,
+        )
+        out = {k: float(v) for k, v in metrics.items()}
+        out["round"] = r
+        self.history.append(out)
+        return out
+
+    def evaluate(self) -> tuple[float, float]:
+        loss, acc = self._eval_fn(self.server_state.params)
+        return float(loss), float(acc)
+
+    def fit(self, rounds: Optional[int] = None, log_fn=None) -> list[dict]:
+        rounds = rounds or self.config.fed.rounds
+        eval_every = max(1, self.config.run.eval_every)
+        last_round = len(self.history) + rounds - 1  # fit() may be called again
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            rec = self.run_round()
+            rec["round_time_s"] = time.perf_counter() - t0
+            if rec["round"] % eval_every == 0 or rec["round"] == last_round:
+                loss, acc = self.evaluate()
+                rec["eval_loss"], rec["eval_acc"] = loss, acc
+            if log_fn is not None:
+                log_fn(rec)
+        return self.history

@@ -1,0 +1,111 @@
+"""Jit-compiled local training: one client's whole round as a single lax.scan.
+
+The reference's hot loop is a Python ``for epoch: for batch:`` PyTorch loop
+inside each PySyft worker process (SURVEY.md §3c).  Here the entire local
+round — E epochs of minibatch SGD, optionally with a FedProx proximal term —
+is one ``lax.scan`` over steps, compiled once and then ``vmap``-ed over the
+client axis (single chip) or ``shard_map``-ed over a mesh (multi chip), per
+BASELINE.json ``north_star`` ("each TPU core simulates one client running
+jit-compiled local SGD").
+
+Straggler handling (SURVEY.md §5 "failure detection"): the scan always runs
+the full static step count, but each client carries a ``step_budget``; steps
+past the budget are masked to no-ops with ``jnp.where``, so a straggler's
+partial progress exists but its FedAvg weight is zeroed by the engine when
+the budget falls below the completion threshold.  Shapes stay static — no
+recompilation per round (SURVEY.md §7 hard part #2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from colearn_federated_learning_tpu.fed import losses
+from colearn_federated_learning_tpu.utils import pytrees
+
+
+class LocalResult(NamedTuple):
+    delta: Any               # params pytree: local_params - global_params
+    num_examples: jnp.ndarray  # () int32 — true shard size (FedAvg weight)
+    completed: jnp.ndarray     # () bool — ran >= min required steps
+    mean_loss: jnp.ndarray     # () float32 over executed steps
+
+
+def _tree_where(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def make_optimizer(lr: float, momentum: float) -> optax.GradientTransformation:
+    """Plain SGD(+momentum) matching torch semantics: buf = m*buf + g;
+    p -= lr*buf (optax ``trace`` with nesterov=False, SURVEY.md §7 hard
+    part #4 — optimizer parity with the reference's PyTorch SGD)."""
+    if momentum > 0:
+        return optax.sgd(lr, momentum=momentum, nesterov=False)
+    return optax.sgd(lr)
+
+
+def make_local_update(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    num_steps: int,
+    batch_size: int,
+    prox_mu: float = 0.0,
+    min_steps_fraction: float = 0.25,
+) -> Callable:
+    """Build ``local_update(global_params, x, y, count, key, step_budget)``.
+
+    - ``x``: (M, ...) padded shard, ``y``: (M,), ``count``: () true size.
+    - ``num_steps`` is the static per-round step budget (epochs * ceil(M/B)).
+    - Sampling: each step draws ``batch_size`` uniform indices in
+      [0, count) — i.i.d. sampling-with-replacement, the standard choice for
+      static-shape federated simulation.
+    """
+    min_steps = max(1, int(num_steps * min_steps_fraction))
+
+    def loss_fn(params, global_params, xb, yb):
+        logits = apply_fn({"params": params}, xb, train=True)
+        loss = losses.softmax_cross_entropy(logits, yb)
+        if prox_mu > 0.0:
+            # FedProx: + μ/2 ‖w − w_global‖² (BASELINE config #3, μ=0.01)
+            loss = loss + 0.5 * prox_mu * pytrees.tree_sq_norm(
+                pytrees.tree_sub(params, global_params)
+            )
+        return loss
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def local_update(global_params, x, y, count, key, step_budget):
+        opt_state = optimizer.init(global_params)
+        safe_count = jnp.maximum(count, 1)
+
+        def step(carry, t):
+            params, opt_state = carry
+            k = jax.random.fold_in(key, t)
+            idx = jax.random.randint(k, (batch_size,), 0, safe_count)
+            xb = jnp.take(x, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+            loss, grads = grad_fn(params, global_params, xb, yb)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            active = t < step_budget
+            params = _tree_where(active, new_params, params)
+            opt_state = _tree_where(active, new_opt_state, opt_state)
+            return (params, opt_state), loss * active
+
+        (params, _), step_losses = jax.lax.scan(
+            step, (global_params, opt_state), jnp.arange(num_steps)
+        )
+        executed = jnp.minimum(step_budget, num_steps).astype(jnp.float32)
+        mean_loss = jnp.sum(step_losses) / jnp.maximum(executed, 1.0)
+        return LocalResult(
+            delta=pytrees.tree_sub(params, global_params),
+            num_examples=count.astype(jnp.int32),
+            completed=step_budget >= min_steps,
+            mean_loss=mean_loss,
+        )
+
+    return local_update

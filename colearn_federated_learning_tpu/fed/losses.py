@@ -1,0 +1,17 @@
+"""Loss and metric functions (float32 accumulation regardless of model dtype)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy(logits, labels) -> jnp.ndarray:
+    """Mean cross-entropy; logits (B, K) float32, labels (B,) int."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+
+
+def accuracy(logits, labels) -> jnp.ndarray:
+    return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32).mean()

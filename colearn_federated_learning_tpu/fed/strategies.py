@@ -1,0 +1,72 @@
+"""Server-side aggregation strategies.
+
+The reference's server step is FedAvg — a host-side weighted mean of client
+state_dicts (SURVEY.md §2 "fed_avg(weights, sizes)", §3a).  Here the server
+consumes the already-aggregated mean DELTA (computed on-device, possibly via
+psum across the mesh) and applies a server optimizer:
+
+- fedavg / fedprox : w ← w + server_lr · Δ̄   (server_lr=1 reproduces the
+  classic weighted-parameter-mean exactly; FedProx differs only in the
+  client loss, fed/local.py)
+- fedadam / fedyogi: adaptive server optimizers (Reddi et al., "Adaptive
+  Federated Optimization" — capability superset of the reference)
+
+All states are pytrees; the whole update jits and shards with the params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.utils import pytrees
+from colearn_federated_learning_tpu.utils.config import FedConfig
+
+
+class ServerState(NamedTuple):
+    params: Any
+    opt_m: Optional[Any]      # first moment (fedadam/fedyogi) or None
+    opt_v: Optional[Any]      # second moment or None
+    round_idx: jnp.ndarray    # () int32
+
+
+def init_server_state(params, cfg: FedConfig) -> ServerState:
+    adaptive = cfg.strategy in ("fedadam", "fedyogi")
+    zeros = pytrees.tree_zeros_like(params)
+    return ServerState(
+        params=params,
+        opt_m=zeros if adaptive else None,
+        opt_v=zeros if adaptive else None,
+        round_idx=jnp.zeros((), jnp.int32),
+    )
+
+
+def server_update(state: ServerState, mean_delta, cfg: FedConfig) -> ServerState:
+    if cfg.strategy in ("fedavg", "fedprox"):
+        new_params = jax.tree.map(
+            lambda w, d: w + cfg.server_lr * d.astype(w.dtype),
+            state.params, mean_delta,
+        )
+        return ServerState(new_params, None, None, state.round_idx + 1)
+
+    if cfg.strategy in ("fedadam", "fedyogi"):
+        b1, b2, eps = cfg.server_beta1, cfg.server_beta2, cfg.server_eps
+        m = jax.tree.map(lambda m_, d: b1 * m_ + (1 - b1) * d, state.opt_m, mean_delta)
+        if cfg.strategy == "fedadam":
+            v = jax.tree.map(
+                lambda v_, d: b2 * v_ + (1 - b2) * jnp.square(d), state.opt_v, mean_delta
+            )
+        else:  # fedyogi
+            v = jax.tree.map(
+                lambda v_, d: v_ - (1 - b2) * jnp.square(d) * jnp.sign(v_ - jnp.square(d)),
+                state.opt_v, mean_delta,
+            )
+        new_params = jax.tree.map(
+            lambda w, m_, v_: w + (cfg.server_lr * m_ / (jnp.sqrt(v_) + eps)).astype(w.dtype),
+            state.params, m, v,
+        )
+        return ServerState(new_params, m, v, state.round_idx + 1)
+
+    raise ValueError(f"unknown strategy {cfg.strategy!r}")

@@ -1,0 +1,10 @@
+"""Model zoo (flax.linen) for the five benchmark configs.
+
+The reference's models are small PyTorch ``nn.Module`` subclasses
+(SURVEY.md §2 "Models").  Here each family is a flax module built
+MXU-first: channels-last conv, bfloat16 compute with float32 params, no
+data-dependent Python control flow, so every client's forward/backward jits
+into one fused XLA program.
+"""
+
+from colearn_federated_learning_tpu.models.registry import build_model  # noqa: F401

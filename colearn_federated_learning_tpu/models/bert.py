@@ -1,0 +1,65 @@
+"""BERT-style text classifier — BASELINE config #4 ("FedAvg BERT-base on
+AG-News, 50 text clients").
+
+A from-scratch encoder (token + learned position embeddings, post-LN
+transformer blocks, masked mean pooling, classification head).  Attention
+and MLPs are plain ``nn.Dense``/einsum matmuls — large, batched, and
+bfloat16-ready so XLA tiles them onto the MXU.  Token id 0 is padding and
+is masked out of both attention and pooling.  Sequence length is static
+(config.seq_len), so the whole model jits with no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TransformerBlock(nn.Module):
+    embed_dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attn_mask):
+        # Post-LN (BERT-style): sublayer -> residual -> LayerNorm.
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype, qkv_features=self.embed_dim
+        )(x, x, mask=attn_mask)
+        x = nn.LayerNorm(dtype=self.dtype)(x + attn)
+        h = nn.Dense(self.embed_dim * self.mlp_ratio, dtype=self.dtype)(x)
+        h = nn.gelu(h)
+        h = nn.Dense(self.embed_dim, dtype=self.dtype)(h)
+        return nn.LayerNorm(dtype=self.dtype)(x + h)
+
+
+class BertClassifier(nn.Module):
+    num_classes: int = 4
+    vocab_size: int = 30522
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    max_len: int = 128
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, train: bool = False):
+        B, L = ids.shape
+        pad_mask = (ids != 0)                                  # (B, L)
+        tok = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(ids)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, self.max_len, self.embed_dim)
+        )
+        x = tok + pos[:, :L].astype(self.dtype)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        attn_mask = nn.make_attention_mask(pad_mask, pad_mask, dtype=self.dtype)
+        for _ in range(self.depth):
+            x = TransformerBlock(self.embed_dim, self.num_heads, dtype=self.dtype)(
+                x, attn_mask
+            )
+        # Masked mean pooling (no [CLS] convention in the synthetic corpus).
+        m = pad_mask[..., None].astype(jnp.float32)
+        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
+        return logits

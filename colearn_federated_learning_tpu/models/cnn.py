@@ -1,0 +1,36 @@
+"""Small conv net — BASELINE config #2 ("FedAvg CNN on CIFAR-10").
+
+Parity target: the reference's CNN-scale PyTorch module (SURVEY.md §2
+"Models"; source unavailable — see SURVEY.md banner).  Design is TPU-first:
+NHWC layout, bfloat16 compute, GroupNorm instead of BatchNorm — batch
+statistics are a poor fit for federated local training (tiny per-client
+batches, stats that would otherwise need cross-client sync) and GroupNorm
+keeps the whole local round a pure function of (params, batch).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNN(nn.Module):
+    num_classes: int = 10
+    width: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for mult in (1, 2, 4):
+            ch = self.width * mult
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.GroupNorm(num_groups=min(32, ch), dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.GroupNorm(num_groups=min(32, ch), dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

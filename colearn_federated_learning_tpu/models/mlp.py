@@ -1,0 +1,27 @@
+"""2-layer MLP — BASELINE config #1 ("FedAvg 2-layer MLP on MNIST").
+
+Parity target: the reference's MNIST MLP-scale ``nn.Module`` (SURVEY.md §2
+"Models: small nets ... MLP/CNN-scale"; reference source unavailable — see
+SURVEY.md banner).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden_dim: int = 200
+    depth: int = 2                      # hidden layers
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for _ in range(self.depth):
+            x = nn.Dense(self.hidden_dim, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,56 @@
+"""Model registry: config name → flax module + init helper."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.utils.config import ModelConfig
+
+
+def _dtype(cfg: ModelConfig):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.dtype]
+
+
+def build_model(cfg: ModelConfig):
+    """Return the flax module for a ModelConfig."""
+    dtype = _dtype(cfg)
+    if cfg.name == "mlp":
+        from colearn_federated_learning_tpu.models.mlp import MLP
+
+        return MLP(num_classes=cfg.num_classes, hidden_dim=cfg.hidden_dim,
+                   depth=cfg.depth, dtype=dtype)
+    if cfg.name == "cnn":
+        from colearn_federated_learning_tpu.models.cnn import CNN
+
+        return CNN(num_classes=cfg.num_classes, width=cfg.width, dtype=dtype)
+    if cfg.name == "resnet18":
+        from colearn_federated_learning_tpu.models.resnet import ResNet18
+
+        return ResNet18(num_classes=cfg.num_classes, width=cfg.width, dtype=dtype)
+    if cfg.name == "bert":
+        from colearn_federated_learning_tpu.models.bert import BertClassifier
+
+        return BertClassifier(num_classes=cfg.num_classes, vocab_size=cfg.vocab_size,
+                              embed_dim=cfg.width, depth=cfg.depth,
+                              num_heads=cfg.num_heads, max_len=cfg.seq_len,
+                              dtype=dtype)
+    if cfg.name == "vit_b16":
+        from colearn_federated_learning_tpu.models.vit import ViT
+
+        return ViT(num_classes=cfg.num_classes, embed_dim=cfg.width,
+                   depth=cfg.depth, num_heads=cfg.num_heads,
+                   patch_size=cfg.patch_size, dtype=dtype)
+    raise KeyError(f"unknown model {cfg.name!r}")
+
+
+def init_params(model, example_x, key: jax.Array):
+    """Initialize float32 parameters for one example batch."""
+    variables = model.init(key, example_x, train=False)
+    if set(variables.keys()) != {"params"}:
+        raise ValueError(
+            f"model carries non-param collections {sorted(variables.keys())}; "
+            "federated local training requires pure-param models "
+            "(use GroupNorm/LayerNorm, not BatchNorm)"
+        )
+    return variables["params"]

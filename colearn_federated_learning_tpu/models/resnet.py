@@ -1,0 +1,71 @@
+"""ResNet-18 (CIFAR variant) — BASELINE config #3 ("FedProx ResNet-18 on
+CIFAR-100").
+
+TPU-first design notes: NHWC layout, bfloat16 compute / float32 params,
+GroupNorm instead of BatchNorm — federated local training with tiny
+per-client batches makes batch statistics both noisy and a hidden piece of
+non-param state that FedAvg would have to aggregate separately; GroupNorm
+keeps the model a pure function of (params, batch), which is what lets one
+``lax.scan`` express a whole local round (fed/local.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    channels: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.channels, (3, 3), strides=(self.stride, self.stride),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=min(32, self.channels), dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.channels), dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.channels, (1, 1),
+                               strides=(self.stride, self.stride),
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.channels),
+                                    dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 100
+    width: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        # CIFAR stem: 3x3, no max-pool (32x32 inputs).
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=min(32, self.width), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        ch = self.width
+        for stage, blocks in enumerate(self.stage_sizes):
+            for b in range(blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                x = BasicBlock(ch, stride=stride, dtype=self.dtype)(x)
+            ch *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet18(num_classes: int = 100, width: int = 64, dtype=jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, width=width,
+                  dtype=dtype)

@@ -1,0 +1,64 @@
+"""ViT-B/16 — BASELINE config #5 ("Cross-silo ViT-B/16 on FEMNIST").
+
+Pre-LN vision transformer: conv patch embedding (a single large matmul per
+image on the MXU), learned position embeddings, class token, GELU MLPs.
+Patch size adapts to small inputs (28x28 FEMNIST → 4x4 patches) while the
+canonical 16 is used at 224 resolution; all shapes are static under jit.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ViTBlock(nn.Module):
+    embed_dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype, qkv_features=self.embed_dim
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.embed_dim * self.mlp_ratio, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.embed_dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int = 62
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    patch_size: int = 16
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, H, W, C = x.shape
+        # Shrink the patch for small images so there are >= 4 patches/side.
+        p = self.patch_size
+        while p > 1 and (H // p) < 4:
+            p //= 2
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), dtype=self.dtype)(
+            x.astype(self.dtype)
+        )
+        x = x.reshape((B, -1, self.embed_dim))                 # (B, N, D)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.embed_dim))
+        x = jnp.concatenate([jnp.tile(cls.astype(self.dtype), (B, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.embed_dim)
+        )
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.depth):
+            x = ViTBlock(self.embed_dim, self.num_heads, dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
+        return logits

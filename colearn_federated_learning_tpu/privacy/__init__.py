@@ -1,0 +1,4 @@
+"""On-device privacy hooks: DP clip+noise and secure-aggregation masking."""
+
+from colearn_federated_learning_tpu.privacy.dp import clip_and_noise  # noqa: F401
+from colearn_federated_learning_tpu.privacy.secure_agg import pairwise_mask  # noqa: F401

@@ -1,0 +1,46 @@
+"""Differential-privacy hooks on client updates, running on-device.
+
+BASELINE.json ``north_star`` requires "the DP-noise ... masking hooks run
+on-device".  This is the standard DP-FedAvg recipe (central DP simulated at
+the clients): clip each client delta to L2 norm ``clip``, then add Gaussian
+noise with per-client std ``clip * noise_multiplier / sqrt(cohort)`` so the
+SUM of cohort-many independent noises has std ``clip * noise_multiplier`` —
+exactly the central Gaussian mechanism.  When DP is on the engine switches
+to uniform (not example-count) weighting, as clipped-update aggregation
+requires for a well-defined sensitivity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.utils import pytrees
+
+
+def clip_by_global_norm(delta, clip: float):
+    """Scale the whole pytree so its global L2 norm is at most ``clip``."""
+    norm = pytrees.tree_global_norm(delta)
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    return pytrees.tree_scale(delta, scale), norm
+
+
+def add_gaussian_noise(delta, std, key: jax.Array):
+    leaves, treedef = jax.tree.flatten(delta)
+    keys = jax.random.split(key, len(leaves))
+    noised = [
+        leaf + std * jax.random.normal(k, leaf.shape, jnp.float32).astype(leaf.dtype)
+        for leaf, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, noised)
+
+
+def clip_and_noise(delta, clip: float, noise_multiplier: float, cohort_size: int,
+                   key: jax.Array):
+    """Per-client DP hook: clip to ``clip``, noise for central std
+    ``clip * noise_multiplier`` after summing ``cohort_size`` clients."""
+    delta, _ = clip_by_global_norm(delta, clip)
+    if noise_multiplier > 0.0:
+        std = clip * noise_multiplier / jnp.sqrt(float(max(cohort_size, 1)))
+        delta = add_gaussian_noise(delta, std, key)
+    return delta

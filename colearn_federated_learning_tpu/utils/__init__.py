@@ -1,0 +1,1 @@
+"""Utility layer: pytree math, per-client PRNG derivation, configuration."""

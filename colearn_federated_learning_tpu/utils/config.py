@@ -1,0 +1,154 @@
+"""Experiment configuration.
+
+The reference parameterizes its scripts with argparse flags (SURVEY.md §2
+"Config/scripts": host/port, broker, rounds, epochs, lr, client count).  The
+rebuild uses frozen dataclasses so a whole experiment is one hashable value
+that can be threaded into jit as static configuration, and ships a registry
+mirroring the five driver benchmark configs from BASELINE.json ``configs``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "mnist"            # registry name (data/registry.py)
+    num_clients: int = 10
+    partition: str = "iid"            # "iid" | "dirichlet"
+    dirichlet_alpha: float = 0.5      # non-IID skew (BASELINE config #2)
+    max_examples_per_client: int = 0  # 0 = derive from dataset size
+    eval_fraction: float = 0.1        # held-out global evaluation shard
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "mlp"                 # models/registry.py name
+    num_classes: int = 10
+    # Family-specific knobs (ignored by families that don't use them):
+    hidden_dim: int = 200             # MLP
+    depth: int = 2                    # MLP layers / transformer blocks
+    width: int = 64                   # CNN base channels / embed dim
+    num_heads: int = 4                # transformers
+    patch_size: int = 16              # ViT
+    seq_len: int = 128                # text models
+    vocab_size: int = 30522           # BERT wordpiece vocab size
+    dtype: str = "float32"            # compute dtype ("bfloat16" on TPU)
+
+
+@dataclasses.dataclass(frozen=True)
+class FedConfig:
+    strategy: str = "fedavg"          # "fedavg" | "fedprox" | "fedadam" | "fedyogi"
+    rounds: int = 20
+    cohort_size: int = 0              # clients sampled per round; 0 = all
+    local_epochs: int = 1
+    local_steps: int = 0              # if >0 overrides epochs with a step budget
+    batch_size: int = 32
+    lr: float = 0.1
+    momentum: float = 0.9
+    prox_mu: float = 0.0              # FedProx μ (BASELINE config #3: 0.01)
+    server_lr: float = 1.0            # server-side step on the mean delta
+    server_beta1: float = 0.9         # FedAdam/FedYogi
+    server_beta2: float = 0.99
+    server_eps: float = 1e-3
+    # Straggler handling (SURVEY.md §5 "failure detection"): each client gets
+    # a per-round step budget; clients whose budget falls below
+    # ``straggler_min_steps`` are dropped from the weighted average.
+    straggler_prob: float = 0.0
+    straggler_min_fraction: float = 0.25
+    # Privacy hooks (BASELINE.json north_star: on-device DP + secure agg).
+    dp_clip: float = 0.0              # 0 disables clipping
+    dp_noise_multiplier: float = 0.0  # Gaussian sigma = mult * clip
+    secure_agg: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    name: str = "default"
+    seed: int = 0
+    backend: str = "auto"             # "auto" | "tpu" | "cpu"  (CLI --backend)
+    clients_per_device: int = 0       # 0 = auto (num_clients / n_devices)
+    mesh_axis: str = "clients"
+    log_every: int = 1
+    eval_every: int = 1
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0         # 0 disables
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    fed: FedConfig = dataclasses.field(default_factory=FedConfig)
+    run: RunConfig = dataclasses.field(default_factory=RunConfig)
+
+    def replace(self, **sections) -> "ExperimentConfig":
+        return dataclasses.replace(self, **sections)
+
+
+def _cfg(**kw) -> ExperimentConfig:
+    return ExperimentConfig(**kw)
+
+
+# The five driver benchmark configs (BASELINE.json "configs", quoted in
+# BASELINE.md).  Model scale knobs follow the named architectures; dataset
+# shapes come from data/registry.py.
+CONFIGS: dict[str, ExperimentConfig] = {
+    # 1. "FedAvg 2-layer MLP on MNIST, 10 simulated clients (CPU baseline)"
+    "mnist_mlp_fedavg": _cfg(
+        data=DataConfig(dataset="mnist", num_clients=10, partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=200, depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=20, local_epochs=1,
+                      batch_size=32, lr=0.1, momentum=0.9),
+        run=RunConfig(name="mnist_mlp_fedavg"),
+    ),
+    # 2. "FedAvg CNN on CIFAR-10, 100 non-IID clients (Dirichlet α=0.5)"
+    "cifar10_cnn_fedavg": _cfg(
+        data=DataConfig(dataset="cifar10", num_clients=100,
+                        partition="dirichlet", dirichlet_alpha=0.5),
+        model=ModelConfig(name="cnn", num_classes=10, width=64,
+                          dtype="bfloat16"),
+        fed=FedConfig(strategy="fedavg", rounds=100, cohort_size=20,
+                      local_epochs=1, batch_size=32, lr=0.05, momentum=0.9),
+        run=RunConfig(name="cifar10_cnn_fedavg"),
+    ),
+    # 3. "FedProx ResNet-18 on CIFAR-100, 100 clients, μ=0.01"
+    "cifar100_resnet18_fedprox": _cfg(
+        data=DataConfig(dataset="cifar100", num_clients=100,
+                        partition="dirichlet", dirichlet_alpha=0.5),
+        model=ModelConfig(name="resnet18", num_classes=100,
+                          dtype="bfloat16"),
+        fed=FedConfig(strategy="fedprox", prox_mu=0.01, rounds=100,
+                      cohort_size=20, local_epochs=1, batch_size=32,
+                      lr=0.05, momentum=0.9),
+        run=RunConfig(name="cifar100_resnet18_fedprox"),
+    ),
+    # 4. "FedAvg BERT-base on AG-News, 50 text clients"
+    "agnews_bert_fedavg": _cfg(
+        data=DataConfig(dataset="agnews", num_clients=50, partition="iid"),
+        model=ModelConfig(name="bert", num_classes=4, width=768, depth=12,
+                          num_heads=12, seq_len=128, dtype="bfloat16"),
+        fed=FedConfig(strategy="fedavg", rounds=50, cohort_size=10,
+                      local_epochs=1, batch_size=16, lr=2e-5, momentum=0.0),
+        run=RunConfig(name="agnews_bert_fedavg"),
+    ),
+    # 5. "Cross-silo ViT-B/16 on FEMNIST, 3400 clients → v5e-256"
+    "femnist_vit_cross_silo": _cfg(
+        data=DataConfig(dataset="femnist", num_clients=3400,
+                        partition="dirichlet", dirichlet_alpha=0.3),
+        model=ModelConfig(name="vit_b16", num_classes=62, width=768,
+                          depth=12, num_heads=12, patch_size=16,
+                          dtype="bfloat16"),
+        fed=FedConfig(strategy="fedavg", rounds=100, cohort_size=256,
+                      local_epochs=1, batch_size=16, lr=0.03, momentum=0.9),
+        run=RunConfig(name="femnist_vit_cross_silo"),
+    ),
+}
+
+
+def get_config(name: str) -> ExperimentConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown config {name!r}; available: {sorted(CONFIGS)}")
+    return CONFIGS[name]

@@ -1,0 +1,85 @@
+"""Deterministic per-client / per-round PRNG key derivation.
+
+The reference relies on each Python worker process's own torch RNG state
+(SURVEY.md §5 "race detection: none; rebuild: deterministic per-client PRNG
+keys").  TPU-native simulation runs every client inside one jit program, so
+randomness must be functional: each (client, round, purpose) gets a key
+derived by ``jax.random.fold_in`` from a single experiment seed.  A given
+client's local-training / DP / mask randomness is therefore identical
+regardless of which device hosts it.  (Cohort SAMPLING is the one
+deliberately placement-dependent draw: the mesh engine samples each
+device's slice of the cohort locally — stratified by device — to avoid
+cross-device data movement; see fed/engine.py.)
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Stable tags so different purposes can never collide even for the same
+# (client, round) pair.
+_TAG_LOCAL = 0x1
+_TAG_SAMPLE = 0x2
+_TAG_DP = 0x3
+_TAG_MASK = 0x4
+_TAG_STRAGGLER = 0x5
+_TAG_INIT = 0x6
+_TAG_DATA = 0x7
+
+
+def experiment_key(seed: int) -> jax.Array:
+    # uint32 key-data form (not the typed-key form): it flows through
+    # shard_map / device_put / checkpoint serialization as a plain array.
+    return jax.random.PRNGKey(seed)
+
+
+def _derive(key: jax.Array, tag: int, *ids) -> jax.Array:
+    key = jax.random.fold_in(key, tag)
+    for i in ids:
+        key = jax.random.fold_in(key, i)
+    return key
+
+
+def init_key(key: jax.Array) -> jax.Array:
+    """Model-initialization key."""
+    return _derive(key, _TAG_INIT)
+
+
+def data_key(key: jax.Array) -> jax.Array:
+    """Dataset synthesis / partitioning key."""
+    return _derive(key, _TAG_DATA)
+
+
+def client_round_key(key: jax.Array, client_id, round_idx) -> jax.Array:
+    """Key for one client's local-training randomness in one round."""
+    return _derive(key, _TAG_LOCAL, client_id, round_idx)
+
+
+def sampling_key(key: jax.Array, round_idx) -> jax.Array:
+    """Key for the coordinator's cohort sampling in one round."""
+    return _derive(key, _TAG_SAMPLE, round_idx)
+
+
+def dp_key(key: jax.Array, client_id, round_idx) -> jax.Array:
+    """Key for a client's DP noise in one round."""
+    return _derive(key, _TAG_DP, client_id, round_idx)
+
+
+def pair_mask_key(key: jax.Array, client_a, client_b, round_idx) -> jax.Array:
+    """Symmetric pairwise key for secure-aggregation masks.
+
+    Ordered so that (a, b) and (b, a) derive the same key — both parties of a
+    pair can expand the identical mask stream, which is what makes the masks
+    cancel inside the aggregate sum (PAPERS.md, Bonawitz et al. 1611.04482,
+    pattern only).
+    """
+    import jax.numpy as jnp
+
+    lo = jnp.minimum(client_a, client_b)
+    hi = jnp.maximum(client_a, client_b)
+    return _derive(key, _TAG_MASK, lo, hi, round_idx)
+
+
+def straggler_key(key: jax.Array, round_idx) -> jax.Array:
+    """Key for simulated straggler step budgets in one round."""
+    return _derive(key, _TAG_STRAGGLER, round_idx)

@@ -1,0 +1,98 @@
+"""Pytree arithmetic used throughout the framework.
+
+The reference aggregator does its weighted averaging with host-side
+``torch.Tensor`` copies inside a Python loop (SURVEY.md §3a "host-side
+fed_avg weighted mean").  Here every model/optimizer state is a plain JAX
+pytree and all the averaging math is expressed as jitted tree maps so XLA
+can fuse it and, under ``shard_map``, lower the reduction to ``lax.psum``
+over ICI (BASELINE.json ``north_star``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def tree_zeros_like(tree: Pytree) -> Pytree:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def tree_add(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def tree_scale(tree: Pytree, s) -> Pytree:
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+def tree_dot(a: Pytree, b: Pytree) -> jax.Array:
+    """Sum of elementwise products over every leaf (a flat inner product)."""
+    leaves = jax.tree.map(lambda x, y: jnp.vdot(x, y), a, b)
+    return jax.tree.reduce(jnp.add, leaves, jnp.zeros((), jnp.float32))
+
+
+def tree_sq_norm(tree: Pytree) -> jax.Array:
+    """Squared L2 norm across all leaves (float32 accumulation)."""
+    leaves = jax.tree.map(lambda x: jnp.sum(jnp.square(x.astype(jnp.float32))), tree)
+    return jax.tree.reduce(jnp.add, leaves, jnp.zeros((), jnp.float32))
+
+
+def tree_global_norm(tree: Pytree) -> jax.Array:
+    return jnp.sqrt(tree_sq_norm(tree))
+
+
+def tree_size(tree: Pytree) -> int:
+    """Total number of scalar parameters (static, host-side)."""
+    return sum(int(x.size) for x in jax.tree.leaves(tree))
+
+
+def tree_weighted_mean(stacked: Pytree, weights: jax.Array) -> Pytree:
+    """Weighted mean over the leading (client) axis of every leaf.
+
+    ``stacked`` has leaves of shape ``(C, ...)``; ``weights`` has shape
+    ``(C,)``.  This is FedAvg's aggregation step (SURVEY.md §2
+    "fed_avg(weights, sizes)") expressed as one fused XLA reduction.  A
+    zero total weight (e.g. every sampled client was a straggler) safely
+    returns zeros instead of NaN so the server update becomes a no-op.
+    """
+    total = jnp.sum(weights)
+    denom = jnp.where(total > 0, total, 1.0)
+
+    def _mean(leaf):
+        w = weights.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(jnp.float32)
+        return (jnp.sum(leaf.astype(jnp.float32) * w, axis=0) / denom).astype(leaf.dtype)
+
+    return jax.tree.map(_mean, stacked)
+
+
+def tree_weighted_sum(stacked: Pytree, weights: jax.Array) -> Pytree:
+    """Weighted sum over the leading (client) axis (use with a later psum)."""
+
+    def _sum(leaf):
+        w = weights.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(jnp.float32)
+        return jnp.sum(leaf.astype(jnp.float32) * w, axis=0)
+
+    return jax.tree.map(_sum, stacked)
+
+
+def tree_cast(tree: Pytree, dtype) -> Pytree:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_stack(trees: list) -> Pytree:
+    """Stack a Python list of identically-structured pytrees along axis 0."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_index(stacked: Pytree, i) -> Pytree:
+    """Select index ``i`` along the leading axis of every leaf."""
+    return jax.tree.map(lambda x: x[i], stacked)

@@ -1,0 +1,39 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the scale-sim strategy from SURVEY.md §7: multi-chip sharding is
+validated on a faked 8-device CPU mesh (``xla_force_host_platform_device_count``)
+because the sandbox has a single real TPU chip.
+"""
+
+import os
+
+# The sandbox boot (sitecustomize) pins JAX_PLATFORMS=axon and may touch the
+# backend before conftest runs, so setting the env var is not enough; the
+# jax.config update below is what actually forces CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
+    return devices
+
+
+@pytest.fixture(scope="session")
+def mesh8(cpu_devices):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(cpu_devices[:8]), ("clients",))

@@ -1,0 +1,90 @@
+"""Local trainer: loss decreases, straggler budgets mask updates, FedProx pulls."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu.fed import local as local_lib
+from colearn_federated_learning_tpu.models.mlp import MLP
+from colearn_federated_learning_tpu.utils import prng, pytrees
+
+
+def _toy_problem(seed=0, n=128, d=8, k=3):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(d, k))
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(n, k)), axis=1).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _setup(num_steps=20, prox_mu=0.0, lr=0.1):
+    model = MLP(num_classes=3, hidden_dim=16, depth=1)
+    x, y = _toy_problem()
+    params = model.init(jax.random.PRNGKey(0), x[:4])["params"]
+    opt = local_lib.make_optimizer(lr, 0.9)
+    update = local_lib.make_local_update(
+        model.apply, opt, num_steps=num_steps, batch_size=16, prox_mu=prox_mu
+    )
+    return model, params, x, y, update
+
+
+def test_local_update_learns():
+    model, params, x, y, update = _setup()
+    key = prng.client_round_key(prng.experiment_key(0), 0, 0)
+    res = update(params, x, y, jnp.asarray(len(x)), key, jnp.asarray(20))
+    assert bool(res.completed)
+    assert int(res.num_examples) == 128
+    # Moved away from init, and the last steps beat the first steps.
+    assert float(pytrees.tree_global_norm(res.delta)) > 0.0
+
+    logits0 = model.apply({"params": params}, x)
+    p1 = jax.tree.map(lambda a, b: a + b, params, res.delta)
+    logits1 = model.apply({"params": p1}, x)
+    acc0 = float((jnp.argmax(logits0, -1) == y).mean())
+    acc1 = float((jnp.argmax(logits1, -1) == y).mean())
+    assert acc1 > acc0
+
+
+def test_zero_budget_is_noop_and_incomplete():
+    _, params, x, y, update = _setup()
+    key = prng.experiment_key(1)
+    res = update(params, x, y, jnp.asarray(len(x)), key, jnp.asarray(0))
+    assert float(pytrees.tree_global_norm(res.delta)) == 0.0
+    assert not bool(res.completed)
+
+
+def test_partial_budget_partial_progress():
+    _, params, x, y, update = _setup(num_steps=20)
+    key = prng.experiment_key(2)
+    res_full = update(params, x, y, jnp.asarray(len(x)), key, jnp.asarray(20))
+    res_half = update(params, x, y, jnp.asarray(len(x)), key, jnp.asarray(10))
+    n_full = float(pytrees.tree_global_norm(res_full.delta))
+    n_half = float(pytrees.tree_global_norm(res_half.delta))
+    assert 0.0 < n_half < n_full
+    assert bool(res_half.completed)  # 10 >= 25% of 20
+
+
+def test_fedprox_term_shrinks_delta():
+    _, params, x, y, update0 = _setup(prox_mu=0.0)
+    _, _, _, _, update_prox = _setup(prox_mu=10.0)
+    key = prng.experiment_key(3)
+    d0 = update0(params, x, y, jnp.asarray(len(x)), key, jnp.asarray(20)).delta
+    dp = update_prox(params, x, y, jnp.asarray(len(x)), key, jnp.asarray(20)).delta
+    assert float(pytrees.tree_global_norm(dp)) < float(pytrees.tree_global_norm(d0))
+
+
+def test_vmap_over_clients_matches_single():
+    _, params, x, y, update = _setup()
+    key0 = prng.client_round_key(prng.experiment_key(0), 0, 0)
+    key1 = prng.client_round_key(prng.experiment_key(0), 1, 0)
+    xs = jnp.stack([x, x * 0.5])
+    ys = jnp.stack([y, y])
+    counts = jnp.asarray([128, 128])
+    keys = jnp.stack([key0, key1])
+    budgets = jnp.asarray([20, 20])
+    batched = jax.vmap(update, in_axes=(None, 0, 0, 0, 0, 0))(
+        params, xs, ys, counts, keys, budgets
+    )
+    single = update(params, x, y, jnp.asarray(128), key0, jnp.asarray(20))
+    for a, b in zip(jax.tree.leaves(batched.delta), jax.tree.leaves(single.delta)):
+        np.testing.assert_allclose(np.asarray(a[0]), np.asarray(b), rtol=2e-4, atol=1e-5)

@@ -1,0 +1,65 @@
+"""Unit tests for the data partitioner (SURVEY.md §7 test strategy: 'unit
+(partitioner stats ...)')."""
+
+import numpy as np
+
+from colearn_federated_learning_tpu.data import partition
+
+
+def test_iid_partition_covers_everything():
+    parts = partition.iid_partition(103, 10, seed=0)
+    allidx = np.concatenate(parts)
+    assert len(allidx) == 103
+    assert len(np.unique(allidx)) == 103
+    sizes = partition.partition_counts(parts)
+    assert sizes.max() - sizes.min() <= 1
+
+
+def test_dirichlet_partition_covers_and_skews():
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, size=5000)
+    parts = partition.dirichlet_partition(labels, 20, alpha=0.1, seed=1)
+    allidx = np.concatenate(parts)
+    assert len(allidx) == 5000
+    assert len(np.unique(allidx)) == 5000
+    assert min(len(p) for p in parts) >= 1
+
+    # Low alpha must be visibly more skewed than near-IID high alpha.
+    dist_lo = partition.label_distribution(labels, parts, 10)
+    parts_hi = partition.dirichlet_partition(labels, 20, alpha=100.0, seed=1)
+    dist_hi = partition.label_distribution(labels, parts_hi, 10)
+
+    def mean_entropy(dist):
+        p = dist / np.maximum(dist.sum(axis=1, keepdims=True), 1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            e = -np.nansum(np.where(p > 0, p * np.log(p), 0.0), axis=1)
+        return e.mean()
+
+    assert mean_entropy(dist_lo) < mean_entropy(dist_hi) - 0.3
+
+
+def test_pack_client_shards_padding_and_counts():
+    from colearn_federated_learning_tpu.data.sharding import pack_client_shards
+
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10).astype(np.int32)
+    parts = [np.array([0, 1, 2, 3, 4]), np.array([5, 6]), np.array([7, 8, 9])]
+    shards = pack_client_shards(x, y, parts)
+    assert shards.x.shape == (3, 5, 2)
+    assert list(shards.counts) == [5, 2, 3]
+    # Padding rows are cyclic copies of the client's own data.
+    np.testing.assert_array_equal(shards.y[1], [5, 6, 5, 6, 5])
+
+
+def test_pad_clients_to_multiple_ghost_clients():
+    from colearn_federated_learning_tpu.data.sharding import (
+        pack_client_shards,
+        pad_clients_to_multiple,
+    )
+
+    x = np.zeros((12, 3), np.float32)
+    y = np.zeros((12,), np.int32)
+    parts = [np.arange(4), np.arange(4, 8), np.arange(8, 12)]
+    shards = pad_clients_to_multiple(pack_client_shards(x, y, parts), 8)
+    assert shards.num_clients == 8
+    assert list(shards.counts[3:]) == [0] * 5  # ghosts have zero weight

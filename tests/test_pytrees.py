@@ -1,0 +1,40 @@
+"""FedAvg math vs numpy (SURVEY.md §7: 'unit ... FedAvg math vs numpy')."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu.utils import pytrees
+
+
+def _stacked_tree(rng, C=5):
+    return {
+        "w": jnp.asarray(rng.normal(size=(C, 4, 3)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(C, 3)).astype(np.float32)),
+    }
+
+
+def test_tree_weighted_mean_matches_numpy():
+    rng = np.random.default_rng(0)
+    tree = _stacked_tree(rng)
+    w = jnp.asarray([1.0, 2.0, 0.0, 4.0, 3.0])
+    out = pytrees.tree_weighted_mean(tree, w)
+    expect = np.average(np.asarray(tree["w"]), axis=0, weights=np.asarray(w))
+    np.testing.assert_allclose(np.asarray(out["w"]), expect, rtol=1e-5)
+
+
+def test_tree_weighted_mean_zero_weights_is_zero_not_nan():
+    rng = np.random.default_rng(1)
+    tree = _stacked_tree(rng)
+    out = pytrees.tree_weighted_mean(tree, jnp.zeros(5))
+    assert np.isfinite(np.asarray(out["w"])).all()
+    np.testing.assert_array_equal(np.asarray(out["b"]), 0.0)
+
+
+def test_tree_norms_and_arithmetic():
+    a = {"x": jnp.asarray([3.0, 0.0]), "y": jnp.asarray([[4.0]])}
+    assert float(pytrees.tree_global_norm(a)) == 5.0
+    assert pytrees.tree_size(a) == 3
+    d = pytrees.tree_sub(a, a)
+    assert float(pytrees.tree_global_norm(d)) == 0.0
+    s = pytrees.tree_scale(a, 2.0)
+    np.testing.assert_array_equal(np.asarray(s["x"]), [6.0, 0.0])
