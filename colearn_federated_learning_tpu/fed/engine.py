@@ -161,7 +161,17 @@ class FederatedLearner:
             d = mesh.devices.size
             # per-device cohort must be equal and static
             self.cohort_per_device = max(1, self.cohort_size // d)
-            self.cohort_size = self.cohort_per_device * d
+            adjusted = self.cohort_per_device * d
+            if adjusted != self.cohort_size:
+                import warnings
+
+                warnings.warn(
+                    f"cohort_size={self.cohort_size} is not a multiple of the "
+                    f"{d}-device mesh; using {adjusted} "
+                    f"({self.cohort_per_device}/device)",
+                    stacklevel=2,
+                )
+            self.cohort_size = adjusted
         # DP noise accounting divides by the number of REAL clients expected
         # to contribute (ghost padding never contributes).  If stragglers
         # drop mid-round the realized central noise is below nominal — a
@@ -295,8 +305,13 @@ class FederatedLearner:
                     server_state.params, sel, cohort_global, cohort_global,
                     x, y, counts, key, round_idx
                 )
-                denom = jnp.maximum(total_w, 1e-12)
-                mean_delta = pytrees.tree_scale(wsum, 1.0 / denom)
+                # Zero contributors (all stragglers) → no-op update.  The
+                # explicit gate matters under secure_agg, where wsum is not
+                # exactly zero but the float32 mask-cancellation residual.
+                denom = jnp.where(total_w > 0, total_w, 1.0)
+                mean_delta = pytrees.tree_scale(
+                    wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
+                )
                 new_state = strategies.server_update(server_state, mean_delta, c)
                 metrics = {
                     "train_loss": loss_sum / denom,
@@ -337,8 +352,12 @@ class FederatedLearner:
             total_w = jax.lax.psum(total_w, ax)
             loss_sum = jax.lax.psum(loss_sum, ax)
             n_comp = jax.lax.psum(n_comp, ax)
-            denom = jnp.maximum(total_w, 1e-12)
-            mean_delta = pytrees.tree_scale(wsum, 1.0 / denom)
+            # Same zero-contributor gate as the vmap path (secure_agg mask
+            # residual must not be amplified by a tiny denominator).
+            denom = jnp.where(total_w > 0, total_w, 1.0)
+            mean_delta = pytrees.tree_scale(
+                wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
+            )
             new_state = strategies.server_update(server_state, mean_delta, c)
             metrics = {
                 "train_loss": loss_sum / denom,

@@ -20,7 +20,6 @@ class DataConfig:
     partition: str = "iid"            # "iid" | "dirichlet"
     dirichlet_alpha: float = 0.5      # non-IID skew (BASELINE config #2)
     max_examples_per_client: int = 0  # 0 = derive from dataset size
-    eval_fraction: float = 0.1        # held-out global evaluation shard
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,7 +68,6 @@ class RunConfig:
     name: str = "default"
     seed: int = 0
     backend: str = "auto"             # "auto" | "tpu" | "cpu"  (CLI --backend)
-    clients_per_device: int = 0       # 0 = auto (num_clients / n_devices)
     mesh_axis: str = "clients"
     log_every: int = 1
     eval_every: int = 1

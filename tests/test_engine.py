@@ -76,13 +76,38 @@ def test_determinism_same_seed_same_result():
 
 
 def test_weighted_aggregation_respects_counts():
-    # Clients with zero weight (ghosts) must not affect the average: run a
-    # learner where every client's data is identical; aggregation must be
-    # finite and the history well-formed.
-    cfg = tiny_config(rounds=1)
-    cfg = dataclasses.replace(
-        cfg, data=dataclasses.replace(cfg.data, num_clients=3)
-    )
+    """A zero-weight client must not affect the weighted aggregate: the
+    weighted sum with weights [w0, w1, 0] equals the one with [w0, w1]."""
+    import jax.numpy as jnp
+
+    from colearn_federated_learning_tpu.utils import pytrees
+
+    rng = np.random.default_rng(0)
+    stacked3 = {"w": jnp.asarray(rng.normal(size=(3, 4, 2)), jnp.float32)}
+    stacked2 = {"w": stacked3["w"][:2]}
+    w3 = jnp.asarray([2.0, 5.0, 0.0])
+    w2 = jnp.asarray([2.0, 5.0])
+    got = pytrees.tree_weighted_sum(stacked3, w3)["w"]
+    want = pytrees.tree_weighted_sum(stacked2, w2)["w"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    got_m = pytrees.tree_weighted_mean(stacked3, w3)["w"]
+    want_m = pytrees.tree_weighted_mean(stacked2, w2)["w"]
+    np.testing.assert_allclose(np.asarray(got_m), np.asarray(want_m), rtol=1e-6)
+
+
+def test_all_stragglers_round_is_noop_under_secure_agg():
+    """If every sampled client is a straggler, the round must be a no-op:
+    the secure-agg mask-cancellation residual must NOT be amplified by the
+    near-zero total weight (regression: engine's zero-contributor gate)."""
+    import jax
+
+    cfg = tiny_config(rounds=1, straggler_prob=1.0, straggler_min_fraction=1.0,
+                      secure_agg=True, dp_clip=1.0)
     learner = FederatedLearner(cfg)
+    before = jax.tree.map(np.asarray, learner.server_state.params)
     rec = learner.run_round()
-    assert rec["total_weight"] > 0
+    assert rec["completed"] == 0
+    assert rec["total_weight"] == 0
+    after = jax.tree.map(np.asarray, learner.server_state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)

@@ -14,7 +14,10 @@ def test_sharded_engine_learns(mesh8):
     learner = FederatedLearner(tiny_config(rounds=4), mesh=mesh8)
     # 10 clients pad to 16 (2 per device), ghosts carry zero weight.
     assert learner.num_clients == 16
-    learner.fit(rounds=4)
+    hist = learner.fit(rounds=4)
+    # Ghosts contribute exactly nothing: the aggregate weight is the sum of
+    # REAL clients' example counts.
+    assert hist[0]["total_weight"] == float(learner.shards.counts.sum())
     _, acc = learner.evaluate()
     assert acc > 0.5
 
