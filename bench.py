@@ -56,6 +56,8 @@ def run_tpu_native(rounds: int, warmup: int) -> dict:
                                         max_test=512)
     learner = FederatedLearner.from_config(config, dataset=dataset)
     n_devices = learner.mesh.devices.size if learner.mesh is not None else 1
+    # Actual per-round work (cohort may be adjusted to the mesh size).
+    samples_per_round = learner.cohort_size * learner.num_steps * BATCH
 
     for _ in range(warmup):
         learner.run_round()
@@ -70,8 +72,7 @@ def run_tpu_native(rounds: int, warmup: int) -> dict:
     rps = rounds / dt
     return {
         "rounds_per_sec": rps,
-        "client_samples_per_sec_per_chip":
-            rps * COHORT * LOCAL_STEPS * BATCH / n_devices,
+        "client_samples_per_sec_per_chip": rps * samples_per_round / n_devices,
         "n_devices": n_devices,
         "platform": jax.devices()[0].platform,
     }

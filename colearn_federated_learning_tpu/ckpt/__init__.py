@@ -1,0 +1,3 @@
+from colearn_federated_learning_tpu.ckpt.manager import RoundCheckpointer
+
+__all__ = ["RoundCheckpointer"]

@@ -1,0 +1,208 @@
+"""`colearn` command line: train / aggregate / eval / init / configs / bench.
+
+Parity surface (BASELINE.json north_star): the reference exposes
+``colearn train`` and ``colearn aggregate`` entrypoints and argparse flags
+for rounds/epochs/lr/client count (SURVEY.md §2 "Config/scripts"); both
+accept ``--backend=tpu|cpu|auto`` here.
+
+Two federation modes:
+- ``train`` (default role ``sim``): the TPU-native simulation — every client
+  trains on-device in one jit program (fed/engine.py).
+- ``train --role client`` + ``aggregate``: cross-silo over files — each silo
+  produces an update file against a global-model file; the aggregator folds
+  them with the configured server strategy (fed/offline.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from colearn_federated_learning_tpu.utils.config import (
+    CONFIGS,
+    ExperimentConfig,
+    get_config,
+)
+
+
+def _add_override_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", default="mnist_mlp_fedavg",
+                   help=f"experiment config; one of {sorted(CONFIGS)}")
+    p.add_argument("--backend", choices=["auto", "tpu", "cpu"], default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--rounds", type=int, default=None)
+    p.add_argument("--num-clients", type=int, default=None)
+    p.add_argument("--cohort-size", type=int, default=None)
+    p.add_argument("--local-epochs", type=int, default=None)
+    p.add_argument("--local-steps", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--strategy", default=None,
+                   choices=["fedavg", "fedprox", "fedadam", "fedyogi"])
+    p.add_argument("--prox-mu", type=float, default=None)
+    p.add_argument("--dataset", default=None)
+    p.add_argument("--partition", default=None, choices=["iid", "dirichlet"])
+    p.add_argument("--dirichlet-alpha", type=float, default=None)
+    p.add_argument("--dp-clip", type=float, default=None)
+    p.add_argument("--dp-noise-multiplier", type=float, default=None)
+    p.add_argument("--secure-agg", action="store_true", default=None)
+    p.add_argument("--straggler-prob", type=float, default=None)
+    p.add_argument("--eval-every", type=int, default=None)
+    p.add_argument("--log-every", type=int, default=None)
+    p.add_argument("--log-file", default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=None)
+
+
+_FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
+             "batch_size", "lr", "momentum", "strategy", "prox_mu",
+             "dp_clip", "dp_noise_multiplier", "secure_agg", "straggler_prob"}
+_DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
+_RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
+             "checkpoint_every"}
+
+
+def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    cfg = get_config(args.config)
+    sections = {"fed": {}, "data": {}, "run": {}}
+    for key, val in vars(args).items():
+        if val is None:
+            continue
+        if key in _FED_KEYS:
+            sections["fed"][key] = val
+        elif key in _DATA_KEYS:
+            sections["data"][key] = val
+        elif key in _RUN_KEYS:
+            sections["run"][key] = val
+    return cfg.replace(
+        fed=dataclasses.replace(cfg.fed, **sections["fed"]),
+        data=dataclasses.replace(cfg.data, **sections["data"]),
+        run=dataclasses.replace(cfg.run, **sections["run"]),
+    )
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    config = config_from_args(args)
+
+    if args.role == "client":
+        from colearn_federated_learning_tpu.fed import offline
+
+        if args.client_id is None or not args.global_model or not args.out:
+            print("train --role client requires --client-id, --global-model, "
+                  "--out", file=sys.stderr)
+            return 2
+        stats = offline.client_update(config, args.client_id,
+                                      args.global_model, args.out)
+        print(json.dumps(stats))
+        return 0
+
+    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+    from colearn_federated_learning_tpu.metrics import MetricsLogger
+
+    learner = FederatedLearner.from_config(config)
+    with MetricsLogger(path=args.log_file, name=config.run.name) as logger:
+        if args.resume:
+            step = learner.restore_checkpoint()
+            print(f"resumed at round {step}", file=sys.stderr)
+
+        def log_fn(rec):
+            logger.log(rec)
+            print(json.dumps(rec), file=sys.stderr)
+
+        learner.fit(log_fn=log_fn)
+        samples = (learner.cohort_size * learner.num_steps
+                   * config.fed.batch_size)
+        n_chips = learner.mesh.devices.size if learner.mesh is not None else 1
+        print(json.dumps(logger.summary(samples_per_round=samples,
+                                        n_chips=n_chips)))
+    return 0
+
+
+def cmd_init(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu.fed import offline
+
+    config = config_from_args(args)
+    offline.init_global_model(config, args.out)
+    print(json.dumps({"out": args.out, "round": 0}))
+    return 0
+
+
+def cmd_aggregate(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu.fed import offline
+
+    config = config_from_args(args)
+    stats = offline.aggregate_updates(config, args.global_model, args.updates,
+                                      args.out)
+    print(json.dumps(stats))
+    return 0
+
+
+def cmd_eval(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu.fed import offline
+
+    config = config_from_args(args)
+    print(json.dumps(offline.evaluate_global(config, args.global_model)))
+    return 0
+
+
+def cmd_configs(_args: argparse.Namespace) -> int:
+    for name, cfg in sorted(CONFIGS.items()):
+        print(f"{name}: {cfg.model.name} on {cfg.data.dataset}, "
+              f"{cfg.data.num_clients} clients, {cfg.fed.strategy}")
+    return 0
+
+
+def cmd_bench(_args: argparse.Namespace) -> int:
+    import bench
+
+    bench.main()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="colearn")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_train = sub.add_parser("train", help="run federated training")
+    _add_override_flags(p_train)
+    p_train.add_argument("--role", choices=["sim", "client"], default="sim")
+    p_train.add_argument("--client-id", type=int, default=None)
+    p_train.add_argument("--global-model", default=None,
+                         help="global model npz (client role)")
+    p_train.add_argument("--out", default=None,
+                         help="update npz to write (client role)")
+    p_train.add_argument("--resume", action="store_true")
+    p_train.set_defaults(fn=cmd_train)
+
+    p_init = sub.add_parser("init", help="write an initial global model file")
+    _add_override_flags(p_init)
+    p_init.add_argument("--out", required=True)
+    p_init.set_defaults(fn=cmd_init)
+
+    p_agg = sub.add_parser("aggregate",
+                           help="fold client update files into a new global model")
+    _add_override_flags(p_agg)
+    p_agg.add_argument("--global-model", required=True)
+    p_agg.add_argument("--updates", nargs="+", required=True)
+    p_agg.add_argument("--out", required=True)
+    p_agg.set_defaults(fn=cmd_aggregate)
+
+    p_eval = sub.add_parser("eval", help="evaluate a global model file")
+    _add_override_flags(p_eval)
+    p_eval.add_argument("--global-model", required=True)
+    p_eval.set_defaults(fn=cmd_eval)
+
+    sub.add_parser("configs", help="list experiment configs").set_defaults(
+        fn=cmd_configs)
+    sub.add_parser("bench", help="run the headline benchmark").set_defaults(
+        fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

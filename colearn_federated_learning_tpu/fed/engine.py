@@ -184,6 +184,7 @@ class FederatedLearner:
         self._eval_fn = self._build_eval_fn()
         self._device_data = self._place_data()
         self.history: list[dict] = []
+        self._ckpt = None
 
     # ------------------------------------------------------------------
     # data placement
@@ -281,6 +282,24 @@ class FederatedLearner:
         n_completed = jnp.sum((completed & nonghost).astype(jnp.int32))
         return wsum, total_w, (loss_sum, n_completed)
 
+    def _finish_round(self, server_state, wsum, total_w, loss_sum, n_comp):
+        """Shared round epilogue (vmap and shard_map paths): mean delta,
+        server update, metrics.  Zero contributors (all stragglers) → no-op
+        update; the explicit gate matters under secure_agg, where wsum is
+        not exactly zero but the float32 mask-cancellation residual."""
+        denom = jnp.where(total_w > 0, total_w, 1.0)
+        mean_delta = pytrees.tree_scale(
+            wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
+        )
+        new_state = strategies.server_update(server_state, mean_delta,
+                                             self.config.fed)
+        metrics = {
+            "train_loss": loss_sum / denom,
+            "completed": n_comp,
+            "total_weight": total_w,
+        }
+        return new_state, metrics
+
     def _build_round_fn(self):
         c = self.config.fed
         ax = self.config.run.mesh_axis
@@ -305,20 +324,8 @@ class FederatedLearner:
                     server_state.params, sel, cohort_global, cohort_global,
                     x, y, counts, key, round_idx
                 )
-                # Zero contributors (all stragglers) → no-op update.  The
-                # explicit gate matters under secure_agg, where wsum is not
-                # exactly zero but the float32 mask-cancellation residual.
-                denom = jnp.where(total_w > 0, total_w, 1.0)
-                mean_delta = pytrees.tree_scale(
-                    wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
-                )
-                new_state = strategies.server_update(server_state, mean_delta, c)
-                metrics = {
-                    "train_loss": loss_sum / denom,
-                    "completed": n_comp,
-                    "total_weight": total_w,
-                }
-                return new_state, metrics
+                return self._finish_round(server_state, wsum, total_w,
+                                          loss_sum, n_comp)
 
             return round_fn
 
@@ -352,19 +359,8 @@ class FederatedLearner:
             total_w = jax.lax.psum(total_w, ax)
             loss_sum = jax.lax.psum(loss_sum, ax)
             n_comp = jax.lax.psum(n_comp, ax)
-            # Same zero-contributor gate as the vmap path (secure_agg mask
-            # residual must not be amplified by a tiny denominator).
-            denom = jnp.where(total_w > 0, total_w, 1.0)
-            mean_delta = pytrees.tree_scale(
-                wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
-            )
-            new_state = strategies.server_update(server_state, mean_delta, c)
-            metrics = {
-                "train_loss": loss_sum / denom,
-                "completed": n_comp,
-                "total_weight": total_w,
-            }
-            return new_state, metrics
+            return self._finish_round(server_state, wsum, total_w,
+                                      loss_sum, n_comp)
 
         sharded = shard_map(
             body,
@@ -435,9 +431,38 @@ class FederatedLearner:
         loss, acc = self._eval_fn(self.server_state.params)
         return float(loss), float(acc)
 
+    # ---- checkpoint/resume (SURVEY.md §5; ckpt/manager.py) -----------
+    def _checkpointer(self):
+        if self._ckpt is None:
+            from colearn_federated_learning_tpu.ckpt import RoundCheckpointer
+
+            if not self.config.run.checkpoint_dir:
+                raise ValueError("config.run.checkpoint_dir is not set")
+            self._ckpt = RoundCheckpointer(self.config.run.checkpoint_dir)
+        return self._ckpt
+
+    def save_checkpoint(self) -> None:
+        self._checkpointer().save(len(self.history), self.server_state, self.history)
+
+    def restore_checkpoint(self) -> int:
+        """Restore the latest checkpoint; returns the resumed round index."""
+        state, history, step = self._checkpointer().restore(self.server_state)
+        self.server_state = state
+        self.history = history
+        return step
+
     def fit(self, rounds: Optional[int] = None, log_fn=None) -> list[dict]:
-        rounds = rounds or self.config.fed.rounds
-        eval_every = max(1, self.config.run.eval_every)
+        """Run ``rounds`` more federated rounds.  ``rounds=None`` means "up
+        to the configured total": after a restore at round k, the default
+        runs the REMAINING config.fed.rounds - k rounds, not a fresh full
+        run."""
+        if rounds is None:
+            rounds = max(0, self.config.fed.rounds - len(self.history))
+        run = self.config.run
+        eval_every = max(1, run.eval_every)
+        log_every = max(1, run.log_every)
+        ckpt_every = max(0, run.checkpoint_every)
+        want_ckpt = bool(run.checkpoint_dir)
         last_round = len(self.history) + rounds - 1  # fit() may be called again
         for _ in range(rounds):
             t0 = time.perf_counter()
@@ -446,6 +471,15 @@ class FederatedLearner:
             if rec["round"] % eval_every == 0 or rec["round"] == last_round:
                 loss, acc = self.evaluate()
                 rec["eval_loss"], rec["eval_acc"] = loss, acc
-            if log_fn is not None:
+            if log_fn is not None and (
+                rec["round"] % log_every == 0 or rec["round"] == last_round
+            ):
                 log_fn(rec)
+            # With a checkpoint_dir, the final round ALWAYS checkpoints even
+            # when no periodic cadence is configured, so --resume works.
+            if want_ckpt and (
+                (ckpt_every and (rec["round"] + 1) % ckpt_every == 0)
+                or rec["round"] == last_round
+            ):
+                self.save_checkpoint()
         return self.history

@@ -1,0 +1,166 @@
+"""Cross-silo federation over files: `colearn train --role client` /
+`colearn aggregate` (BASELINE.json north_star entrypoints).
+
+This is the decoupled counterpart of the in-process engine: each silo trains
+locally against a global-model file and writes a weighted update file; the
+aggregator folds any number of update files into a new global model with the
+same server strategies as the on-device path.  Payloads use
+utils/serialization.py npz — identical to what the TCP transport (comm/)
+streams, so a silo can switch between file-drop and socket federation
+without retraining.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu.data import partition as partition_lib
+from colearn_federated_learning_tpu.data import registry as data_registry
+from colearn_federated_learning_tpu.data.sharding import pack_client_shards
+from colearn_federated_learning_tpu.fed import local as local_lib
+from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu.models import registry as model_registry
+from colearn_federated_learning_tpu.privacy import dp as dp_lib
+from colearn_federated_learning_tpu.utils import prng, pytrees
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+from colearn_federated_learning_tpu.utils.serialization import (
+    load_pytree_npz,
+    save_pytree_npz,
+)
+
+
+def init_global_model(config: ExperimentConfig, path: str) -> None:
+    """Initialize global params from the experiment seed and write them."""
+    ds = data_registry.get_dataset(config.data.dataset, seed=config.run.seed,
+                                   max_train=4 * config.fed.batch_size,
+                                   max_test=1)
+    model = model_registry.build_model(config.model)
+    x = jnp.asarray(ds.x_train[: config.fed.batch_size])
+    params = model_registry.init_params(
+        model, x, prng.init_key(prng.experiment_key(config.run.seed))
+    )
+    save_pytree_npz(path, jax.tree.map(np.asarray, params),
+                    meta={"round": 0, "config": config.run.name})
+
+
+def client_update(
+    config: ExperimentConfig,
+    client_id: int,
+    global_path: str,
+    out_path: str,
+    round_idx: int = 0,
+    dataset: Optional[data_registry.Dataset] = None,
+) -> dict:
+    """One silo's local round: load global params, train on the silo's
+    partition, write a weighted delta update file.  Returns summary stats."""
+    c = config
+    params, meta = load_pytree_npz(global_path)
+    round_idx = int(meta.get("round", round_idx))
+
+    ds = dataset or data_registry.get_dataset(c.data.dataset, seed=c.run.seed)
+    labels = np.asarray(ds.y_train)
+    if c.data.partition == "dirichlet":
+        parts = partition_lib.dirichlet_partition(
+            labels, c.data.num_clients, c.data.dirichlet_alpha, seed=c.run.seed
+        )
+    else:
+        parts = partition_lib.iid_partition(len(labels), c.data.num_clients,
+                                            seed=c.run.seed)
+    if not 0 <= client_id < len(parts):
+        raise ValueError(f"client_id {client_id} out of range [0, {len(parts)})")
+    shards = pack_client_shards(np.asarray(ds.x_train), labels,
+                                [parts[client_id]],
+                                capacity=c.data.max_examples_per_client)
+
+    if c.fed.local_steps > 0:
+        num_steps = c.fed.local_steps
+    else:
+        steps_per_epoch = max(1, int(np.ceil(shards.capacity / c.fed.batch_size)))
+        num_steps = c.fed.local_epochs * steps_per_epoch
+    optimizer = local_lib.make_optimizer(c.fed.lr, c.fed.momentum)
+    update_fn = jax.jit(local_lib.make_local_update(
+        model_registry.build_model(c.model).apply, optimizer,
+        num_steps=num_steps, batch_size=c.fed.batch_size,
+        prox_mu=c.fed.prox_mu if c.fed.strategy == "fedprox" else 0.0,
+        min_steps_fraction=c.fed.straggler_min_fraction,
+    ))
+    key = prng.experiment_key(c.run.seed)
+    result = update_fn(
+        params,
+        jnp.asarray(shards.x[0]),
+        jnp.asarray(shards.y[0]),
+        jnp.asarray(shards.counts[0]),
+        prng.client_round_key(key, client_id, round_idx),
+        jnp.asarray(num_steps, jnp.int32),
+    )
+    delta = result.delta
+    weight = float(result.num_examples)
+    if c.fed.dp_clip > 0.0:
+        delta = dp_lib.clip_and_noise(
+            delta, c.fed.dp_clip, c.fed.dp_noise_multiplier,
+            max(c.fed.cohort_size or c.data.num_clients, 1),
+            prng.dp_key(key, client_id, round_idx),
+        )
+        weight = 1.0  # uniform weighting under DP, as in the engine
+
+    save_pytree_npz(out_path, jax.tree.map(np.asarray, delta),
+                    meta={"round": round_idx, "weight": weight,
+                          "client_id": client_id,
+                          "num_examples": int(result.num_examples),
+                          "mean_loss": float(result.mean_loss)})
+    return {"client_id": client_id, "round": round_idx, "weight": weight,
+            "mean_loss": float(result.mean_loss)}
+
+
+def aggregate_updates(
+    config: ExperimentConfig,
+    global_path: str,
+    update_paths: list[str],
+    out_path: str,
+) -> dict:
+    """`colearn aggregate`: fold silo update files into a new global model
+    using the configured server strategy (fed/strategies.py)."""
+    if not update_paths:
+        raise ValueError("aggregate_updates: no update files given")
+    params, meta = load_pytree_npz(global_path)
+    round_idx = int(meta.get("round", 0))
+
+    wsum = None
+    total_w = 0.0
+    for p in update_paths:
+        delta, umeta = load_pytree_npz(p)
+        w = float(umeta.get("weight", 1.0))
+        contrib = pytrees.tree_scale(delta, w)
+        wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)
+        total_w += w
+    if total_w <= 0:
+        raise ValueError("aggregate_updates: total weight is zero")
+    mean_delta = pytrees.tree_scale(wsum, 1.0 / total_w)
+
+    state = strategies.init_server_state(params, config.fed)
+    state = strategies.server_update(state, mean_delta, config.fed)
+    save_pytree_npz(out_path, jax.tree.map(np.asarray, state.params),
+                    meta={"round": round_idx + 1, "config": config.run.name,
+                          "num_updates": len(update_paths),
+                          "total_weight": total_w})
+    return {"round": round_idx + 1, "num_updates": len(update_paths),
+            "total_weight": total_w}
+
+
+def evaluate_global(config: ExperimentConfig, global_path: str,
+                    dataset: Optional[data_registry.Dataset] = None) -> dict:
+    """Evaluator role (SURVEY.md §3d): score a global-model file."""
+    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+
+    params, meta = load_pytree_npz(global_path)
+    learner = FederatedLearner(config, dataset=dataset)
+    learner.server_state = learner.server_state._replace(
+        params=jax.tree.map(jnp.asarray, params)
+    )
+    loss, acc = learner.evaluate()
+    return {"round": int(meta.get("round", 0)), "eval_loss": loss,
+            "eval_acc": acc}

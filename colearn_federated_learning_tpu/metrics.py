@@ -1,0 +1,77 @@
+"""Structured metrics: JSONL records + throughput counters.
+
+The reference logs accuracy-per-round with prints/CSV (SURVEY.md §5
+"Metrics/logging").  The rebuild emits structured JSONL — one record per
+federated round — and computes the BASELINE.json headline counters:
+``rounds_per_sec``, ``client_samples_per_sec_per_chip``, and ``acc@round``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import IO, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL round log with throughput summarization.
+
+    Every record gets ``ts`` (wall clock) and the experiment ``name``;
+    ``summary()`` folds the stream into the headline throughput numbers.
+    """
+
+    def __init__(self, path: Optional[str] = None, name: str = "default",
+                 stream: Optional[IO] = None):
+        self.name = name
+        self.path = path
+        self._fh: Optional[IO] = stream
+        self._owns_fh = False
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+            self._owns_fh = True
+        self.records: list[dict] = []
+        self._t_start = time.perf_counter()
+
+    def log(self, record: dict) -> dict:
+        rec = dict(record)
+        rec.setdefault("name", self.name)
+        rec.setdefault("ts", time.time())
+        self.records.append(rec)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+        return rec
+
+    def summary(self, samples_per_round: float = 0.0, n_chips: int = 1) -> dict:
+        rounds = [r for r in self.records if "round" in r]
+        elapsed = time.perf_counter() - self._t_start
+        out = {
+            "name": self.name,
+            "rounds": len(rounds),
+            "elapsed_s": elapsed,
+        }
+        timed = [r["round_time_s"] for r in rounds if "round_time_s" in r]
+        if timed:
+            out["rounds_per_sec"] = len(timed) / sum(timed)
+            if samples_per_round:
+                out["client_samples_per_sec_per_chip"] = (
+                    out["rounds_per_sec"] * samples_per_round / max(n_chips, 1)
+                )
+        accs = [(r["round"], r["eval_acc"]) for r in rounds if "eval_acc" in r]
+        if accs:
+            out["final_acc"] = accs[-1][1]
+            out["best_acc"] = max(a for _, a in accs)
+            out["acc_at_round"] = dict(accs)
+        return out
+
+    def close(self) -> None:
+        if self._fh is not None and self._owns_fh:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

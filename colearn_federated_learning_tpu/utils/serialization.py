@@ -1,0 +1,65 @@
+"""Flat-file pytree serialization (npz) for the cross-silo file/wire plane.
+
+The reference moves model state between processes as pickled PySyft tensors
+over websockets (SURVEY.md §1 "Communication").  The rebuild's exchange
+format is a plain ``.npz``: each leaf stored under its ``/``-joined tree
+path, plus ``__meta__`` JSON for scalars (weights, round index).  It is
+mmap-friendly, language-neutral, and the same payload is used by the offline
+``colearn aggregate`` flow and the TCP federation transport (comm/).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any
+
+import numpy as np
+
+_META = "__meta__"
+
+
+def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], f"{prefix}{k}/"))
+        return out
+    out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> Any:
+    tree: dict = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def save_pytree_npz(path_or_file, tree: Any, meta: dict | None = None) -> None:
+    flat = _flatten(tree)
+    flat[_META] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    ).copy()
+    np.savez(path_or_file, **flat)
+
+
+def load_pytree_npz(path_or_file) -> tuple[Any, dict]:
+    z = np.load(path_or_file)
+    meta = json.loads(bytes(z[_META]).decode()) if _META in z.files else {}
+    flat = {k: z[k] for k in z.files if k != _META}
+    return _unflatten(flat), meta
+
+
+def pytree_to_bytes(tree: Any, meta: dict | None = None) -> bytes:
+    buf = io.BytesIO()
+    save_pytree_npz(buf, tree, meta)
+    return buf.getvalue()
+
+
+def bytes_to_pytree(data: bytes) -> tuple[Any, dict]:
+    return load_pytree_npz(io.BytesIO(data))

@@ -1,0 +1,82 @@
+"""Checkpoint/resume (ckpt/) and metrics (metrics.py) round-trips."""
+
+import json
+
+import numpy as np
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.metrics import MetricsLogger
+from tests.test_engine import tiny_config
+
+
+def test_metrics_jsonl_and_summary(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path=path, name="t") as m:
+        m.log({"round": 0, "round_time_s": 0.5, "eval_acc": 0.4})
+        m.log({"round": 1, "round_time_s": 0.5, "eval_acc": 0.9})
+        s = m.summary(samples_per_round=100, n_chips=2)
+    assert s["rounds"] == 2
+    np.testing.assert_allclose(s["rounds_per_sec"], 2.0)
+    np.testing.assert_allclose(s["client_samples_per_sec_per_chip"], 100.0)
+    assert s["final_acc"] == 0.9 and s["best_acc"] == 0.9
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2 and lines[0]["name"] == "t"
+
+
+def test_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """Train 4 rounds straight vs 2 + checkpoint + restore + 2: identical."""
+    import dataclasses
+    import jax
+
+    base_cfg = tiny_config(rounds=4)
+    cfg = base_cfg.replace(run=dataclasses.replace(
+        base_cfg.run, checkpoint_dir=str(tmp_path / "ck")))
+
+    straight = FederatedLearner(base_cfg)  # no checkpointing
+    straight.fit(rounds=4)
+
+    first = FederatedLearner(cfg)
+    first.fit(rounds=2)
+    first.save_checkpoint()
+
+    resumed = FederatedLearner(cfg)
+    step = resumed.restore_checkpoint()
+    assert step == 2
+    resumed.fit(rounds=2)
+
+    assert resumed.evaluate() == straight.evaluate()
+    for a, b in zip(jax.tree.leaves(straight.server_state.params),
+                    jax.tree.leaves(resumed.server_state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fit_auto_checkpoints(tmp_path):
+    import dataclasses
+
+    cfg = tiny_config(rounds=3)
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2))
+    learner = FederatedLearner(cfg)
+    learner.fit(rounds=3)
+    fresh = FederatedLearner(cfg)
+    step = fresh.restore_checkpoint()
+    assert step == 3  # final round always checkpoints
+    assert len(fresh.history) == 3
+    # fit() default = REMAINING rounds to the configured total (0 here).
+    fresh.fit()
+    assert len(fresh.history) == 3
+
+
+def test_checkpoint_dir_without_cadence_saves_final_round(tmp_path):
+    import dataclasses
+
+    cfg = tiny_config(rounds=2)
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, checkpoint_dir=str(tmp_path / "ck")))  # checkpoint_every=0
+    learner = FederatedLearner(cfg)
+    learner.fit()
+    fresh = FederatedLearner(cfg)
+    assert fresh.restore_checkpoint() == 2
+    # resume default runs only the remaining rounds (none)
+    fresh.fit()
+    assert len(fresh.history) == 2
