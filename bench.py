@@ -1,174 +1,9 @@
-"""Headline benchmark: FedAvg rounds/sec on the CIFAR-10 CNN config.
+"""Driver entry: headline benchmark (see colearn_federated_learning_tpu/bench.py).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-
-The measured workload is BASELINE.json's headline metric ("FedAvg rounds/sec
-and client-samples/sec/chip; CIFAR-10 acc@round"): a federated round of the
-CIFAR-10 CNN config — cohort of clients, each running jit-compiled local SGD
-on-device, FedAvg aggregation in-XLA (psum over a mesh when >1 device).
-
-``vs_baseline`` compares against a faithful reference-style implementation
-run in-process (SURVEY.md §3a: sequential per-client PyTorch-CPU local
-training + host-side state_dict weighted averaging — the reference's
-PySyft-worker architecture minus the network, which only makes the baseline
-FASTER than the real thing).  There are no published reference numbers
-(BASELINE.json "published" is {}), so this measured stand-in is the baseline.
 """
 
-from __future__ import annotations
-
-import argparse
-import json
-import sys
-import time
-
-
-# Workload: scaled CIFAR-10 CNN FedAvg (BASELINE config #2 shape).
-COHORT = 16
-LOCAL_STEPS = 8
-BATCH = 32
-WIDTH = 64
-NUM_CLIENTS = 64
-
-
-def run_tpu_native(rounds: int, warmup: int) -> dict:
-    import jax
-
-    from colearn_federated_learning_tpu.data import registry as data_registry
-    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
-    from colearn_federated_learning_tpu.utils.config import (
-        DataConfig, ExperimentConfig, FedConfig, ModelConfig, RunConfig,
-    )
-
-    config = ExperimentConfig(
-        data=DataConfig(dataset="cifar10", num_clients=NUM_CLIENTS,
-                        partition="dirichlet", dirichlet_alpha=0.5,
-                        max_examples_per_client=256),
-        model=ModelConfig(name="cnn", num_classes=10, width=WIDTH,
-                          dtype="bfloat16"),
-        fed=FedConfig(strategy="fedavg", cohort_size=COHORT,
-                      local_steps=LOCAL_STEPS, batch_size=BATCH,
-                      lr=0.05, momentum=0.9),
-        run=RunConfig(name="bench", backend="auto"),
-    )
-    dataset = data_registry.get_dataset("cifar10", seed=0,
-                                        max_train=NUM_CLIENTS * 256,
-                                        max_test=512)
-    learner = FederatedLearner.from_config(config, dataset=dataset)
-    n_devices = learner.mesh.devices.size if learner.mesh is not None else 1
-    # Actual per-round work (cohort may be adjusted to the mesh size).
-    samples_per_round = learner.cohort_size * learner.num_steps * BATCH
-
-    for _ in range(warmup):
-        learner.run_round()
-    jax.block_until_ready(learner.server_state.params)
-
-    t0 = time.perf_counter()
-    for _ in range(rounds):
-        learner.run_round()
-    jax.block_until_ready(learner.server_state.params)
-    dt = time.perf_counter() - t0
-
-    rps = rounds / dt
-    return {
-        "rounds_per_sec": rps,
-        "client_samples_per_sec_per_chip": rps * samples_per_round / n_devices,
-        "n_devices": n_devices,
-        "platform": jax.devices()[0].platform,
-    }
-
-
-def run_reference_style(rounds: int) -> dict:
-    """Reference architecture stand-in: sequential per-client torch-CPU SGD +
-    host-side numpy weighted averaging of state_dicts (SURVEY.md §3a/§3c)."""
-    import numpy as np
-    import torch
-    import torch.nn as tnn
-
-    torch.manual_seed(0)
-
-    class TorchCNN(tnn.Module):
-        # Same op graph as colearn_federated_learning_tpu/models/cnn.py.
-        def __init__(self, width=WIDTH, num_classes=10):
-            super().__init__()
-            layers, in_ch = [], 3
-            for mult in (1, 2, 4):
-                ch = width * mult
-                layers += [
-                    tnn.Conv2d(in_ch, ch, 3, padding=1),
-                    tnn.GroupNorm(min(32, ch), ch), tnn.ReLU(),
-                    tnn.Conv2d(ch, ch, 3, padding=1),
-                    tnn.GroupNorm(min(32, ch), ch), tnn.ReLU(),
-                    tnn.MaxPool2d(2),
-                ]
-                in_ch = ch
-            self.features = tnn.Sequential(*layers)
-            self.head = tnn.Linear(in_ch, num_classes)
-
-        def forward(self, x):
-            h = self.features(x)
-            return self.head(h.mean(dim=(2, 3)))
-
-    rng = np.random.default_rng(0)
-    data = [
-        (torch.randn(LOCAL_STEPS, BATCH, 3, 32, 32),
-         torch.from_numpy(rng.integers(0, 10, (LOCAL_STEPS, BATCH))).long())
-        for _ in range(COHORT)
-    ]
-    global_model = TorchCNN()
-    global_sd = {k: v.clone() for k, v in global_model.state_dict().items()}
-    loss_fn = tnn.CrossEntropyLoss()
-
-    t0 = time.perf_counter()
-    for _ in range(rounds):
-        updates, weights = [], []
-        for cx, cy in data:  # sequential workers, as in the reference
-            model = TorchCNN()
-            model.load_state_dict(global_sd)  # "broadcast"
-            opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
-            for s in range(LOCAL_STEPS):
-                opt.zero_grad()
-                loss_fn(model(cx[s]), cy[s]).backward()
-                opt.step()
-            # "websocket return": state_dict to host numpy
-            updates.append({k: v.detach().numpy() for k, v in model.state_dict().items()})
-            weights.append(LOCAL_STEPS * BATCH)
-        # host-side fed_avg(weights, sizes)
-        total = float(sum(weights))
-        global_sd = {
-            k: torch.from_numpy(
-                sum(w * u[k] for w, u in zip(weights, updates)) / total
-            )
-            for k in updates[0]
-        }
-    dt = time.perf_counter() - t0
-    return {"rounds_per_sec": rounds / dt}
-
-
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--rounds", type=int, default=20)
-    p.add_argument("--warmup", type=int, default=2)
-    p.add_argument("--baseline-rounds", type=int, default=1)
-    p.add_argument("--skip-baseline", action="store_true")
-    args = p.parse_args()
-
-    ours = run_tpu_native(args.rounds, args.warmup)
-    print(f"[bench] tpu-native: {ours}", file=sys.stderr)
-
-    vs = 0.0
-    if not args.skip_baseline:
-        base = run_reference_style(args.baseline_rounds)
-        print(f"[bench] reference-style torch-cpu: {base}", file=sys.stderr)
-        vs = ours["rounds_per_sec"] / base["rounds_per_sec"]
-
-    print(json.dumps({
-        "metric": "fedavg_cifar10_cnn_rounds_per_sec",
-        "value": round(ours["rounds_per_sec"], 4),
-        "unit": "rounds/sec",
-        "vs_baseline": round(vs, 4),
-    }))
-
+from colearn_federated_learning_tpu.bench import main
 
 if __name__ == "__main__":
     main()

@@ -155,10 +155,14 @@ def cmd_configs(_args: argparse.Namespace) -> int:
     return 0
 
 
-def cmd_bench(_args: argparse.Namespace) -> int:
-    import bench
+def cmd_bench(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu import bench
 
-    bench.main()
+    argv = ["--rounds", str(args.rounds), "--warmup", str(args.warmup),
+            "--baseline-rounds", str(args.baseline_rounds)]
+    if args.skip_baseline:
+        argv.append("--skip-baseline")
+    bench.main(argv)
     return 0
 
 
@@ -197,8 +201,12 @@ def main(argv: list[str] | None = None) -> int:
 
     sub.add_parser("configs", help="list experiment configs").set_defaults(
         fn=cmd_configs)
-    sub.add_parser("bench", help="run the headline benchmark").set_defaults(
-        fn=cmd_bench)
+    p_bench = sub.add_parser("bench", help="run the headline benchmark")
+    p_bench.add_argument("--rounds", type=int, default=20)
+    p_bench.add_argument("--warmup", type=int, default=2)
+    p_bench.add_argument("--baseline-rounds", type=int, default=1)
+    p_bench.add_argument("--skip-baseline", action="store_true")
+    p_bench.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -27,14 +27,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from colearn_federated_learning_tpu.data import registry as data_registry
-from colearn_federated_learning_tpu.data import partition as partition_lib
 from colearn_federated_learning_tpu.data.sharding import (
     ClientShards,
     pack_client_shards,
     pad_clients_to_multiple,
 )
-from colearn_federated_learning_tpu.fed import local as local_lib
+from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
 from colearn_federated_learning_tpu.models import registry as model_registry
 from colearn_federated_learning_tpu.privacy import dp as dp_lib
 from colearn_federated_learning_tpu.privacy import secure_agg as sa_lib
@@ -97,14 +97,7 @@ class FederatedLearner:
             c.data.dataset, seed=c.run.seed
         )
         labels = np.asarray(self.dataset.y_train)
-        if c.data.partition == "dirichlet":
-            parts = partition_lib.dirichlet_partition(
-                labels, c.data.num_clients, c.data.dirichlet_alpha, seed=c.run.seed
-            )
-        else:
-            parts = partition_lib.iid_partition(
-                len(labels), c.data.num_clients, seed=c.run.seed
-            )
+        parts = setup_lib.partition_for_config(c, labels)
         shards = pack_client_shards(
             np.asarray(self.dataset.x_train), labels, parts,
             capacity=c.data.max_examples_per_client,
@@ -139,19 +132,8 @@ class FederatedLearner:
         self.server_state = strategies.init_server_state(self.params, c.fed)
 
         # --- local trainer -------------------------------------------
-        if c.fed.local_steps > 0:
-            self.num_steps = c.fed.local_steps
-        else:
-            steps_per_epoch = max(1, int(np.ceil(shards.capacity / c.fed.batch_size)))
-            self.num_steps = c.fed.local_epochs * steps_per_epoch
-        self.optimizer = local_lib.make_optimizer(c.fed.lr, c.fed.momentum)
-        self.local_update = local_lib.make_local_update(
-            self.model.apply,
-            self.optimizer,
-            num_steps=self.num_steps,
-            batch_size=c.fed.batch_size,
-            prox_mu=c.fed.prox_mu if c.fed.strategy == "fedprox" else 0.0,
-            min_steps_fraction=c.fed.straggler_min_fraction,
+        self.local_update, self.num_steps = setup_lib.local_trainer_for_config(
+            c, self.model.apply, shards.capacity
         )
 
         # --- cohort ---------------------------------------------------
@@ -375,41 +357,12 @@ class FederatedLearner:
     # evaluation (held-out global test set, SURVEY.md §3d)
     # ------------------------------------------------------------------
     def _build_eval_fn(self):
-        batch = max(self.config.fed.batch_size, 64)
-        x_test = np.asarray(self.dataset.x_test)
-        y_test = np.asarray(self.dataset.y_test)
-        n = len(x_test)
-        n_batches = int(np.ceil(n / batch))
-        pad = n_batches * batch - n
-        x_pad = np.concatenate([x_test, np.zeros((pad,) + x_test.shape[1:], x_test.dtype)])
-        y_pad = np.concatenate([y_test, np.zeros((pad,), y_test.dtype)])
-        mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
-        xb = jnp.asarray(x_pad.reshape((n_batches, batch) + x_test.shape[1:]))
-        yb = jnp.asarray(y_pad.reshape((n_batches, batch)))
-        mb = jnp.asarray(mask.reshape((n_batches, batch)))
-        apply_fn = self.model.apply
-
-        @jax.jit
-        def eval_fn(params):
-            def step(carry, inp):
-                x, y, m = inp
-                logits = apply_fn({"params": params}, x, train=False)
-                ce = jax.nn.log_softmax(logits.astype(jnp.float32))
-                nll = -jnp.take_along_axis(ce, y[:, None], axis=1)[:, 0]
-                correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
-                loss_sum, acc_sum, m_sum = carry
-                return (
-                    loss_sum + jnp.sum(nll * m),
-                    acc_sum + jnp.sum(correct * m),
-                    m_sum + jnp.sum(m),
-                ), None
-
-            (loss_sum, acc_sum, m_sum), _ = jax.lax.scan(
-                step, (0.0, 0.0, 0.0), (xb, yb, mb)
-            )
-            return loss_sum / m_sum, acc_sum / m_sum
-
-        return eval_fn
+        return make_eval_fn(
+            self.model.apply,
+            self.dataset.x_test,
+            self.dataset.y_test,
+            batch=max(self.config.fed.batch_size, 64),
+        )
 
     # ------------------------------------------------------------------
     # public API

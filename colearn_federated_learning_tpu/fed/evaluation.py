@@ -1,0 +1,56 @@
+"""Held-out evaluation (SURVEY.md §3d evaluator role), standalone.
+
+One jit-compiled scan over padded test batches — shared by the engine's
+periodic eval and the file-based evaluator (`colearn eval`), which needs no
+training setup at all.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_eval_fn(apply_fn: Callable, x_test, y_test, batch: int) -> Callable:
+    """Build ``eval_fn(params) -> (mean_loss, accuracy)`` over the test set.
+
+    The set is padded to a whole number of ``batch``-sized chunks with a
+    validity mask, then reduced in a single ``lax.scan`` — static shapes,
+    one compile.
+    """
+    x_test = np.asarray(x_test)
+    y_test = np.asarray(y_test)
+    n = len(x_test)
+    n_batches = int(np.ceil(n / batch))
+    pad = n_batches * batch - n
+    x_pad = np.concatenate([x_test, np.zeros((pad,) + x_test.shape[1:], x_test.dtype)])
+    y_pad = np.concatenate([y_test, np.zeros((pad,), y_test.dtype)])
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    xb = jnp.asarray(x_pad.reshape((n_batches, batch) + x_test.shape[1:]))
+    yb = jnp.asarray(y_pad.reshape((n_batches, batch)))
+    mb = jnp.asarray(mask.reshape((n_batches, batch)))
+
+    @jax.jit
+    def eval_fn(params):
+        def step(carry, inp):
+            x, y, m = inp
+            logits = apply_fn({"params": params}, x, train=False)
+            ce = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nll = -jnp.take_along_axis(ce, y[:, None], axis=1)[:, 0]
+            correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+            loss_sum, acc_sum, m_sum = carry
+            return (
+                loss_sum + jnp.sum(nll * m),
+                acc_sum + jnp.sum(correct * m),
+                m_sum + jnp.sum(m),
+            ), None
+
+        (loss_sum, acc_sum, m_sum), _ = jax.lax.scan(
+            step, (0.0, 0.0, 0.0), (xb, yb, mb)
+        )
+        return loss_sum / m_sum, acc_sum / m_sum
+
+    return eval_fn

@@ -18,10 +18,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from colearn_federated_learning_tpu.data import partition as partition_lib
 from colearn_federated_learning_tpu.data import registry as data_registry
 from colearn_federated_learning_tpu.data.sharding import pack_client_shards
-from colearn_federated_learning_tpu.fed import local as local_lib
+from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu.models import registry as model_registry
 from colearn_federated_learning_tpu.privacy import dp as dp_lib
@@ -63,31 +62,17 @@ def client_update(
 
     ds = dataset or data_registry.get_dataset(c.data.dataset, seed=c.run.seed)
     labels = np.asarray(ds.y_train)
-    if c.data.partition == "dirichlet":
-        parts = partition_lib.dirichlet_partition(
-            labels, c.data.num_clients, c.data.dirichlet_alpha, seed=c.run.seed
-        )
-    else:
-        parts = partition_lib.iid_partition(len(labels), c.data.num_clients,
-                                            seed=c.run.seed)
+    parts = setup_lib.partition_for_config(c, labels)
     if not 0 <= client_id < len(parts):
         raise ValueError(f"client_id {client_id} out of range [0, {len(parts)})")
     shards = pack_client_shards(np.asarray(ds.x_train), labels,
                                 [parts[client_id]],
                                 capacity=c.data.max_examples_per_client)
 
-    if c.fed.local_steps > 0:
-        num_steps = c.fed.local_steps
-    else:
-        steps_per_epoch = max(1, int(np.ceil(shards.capacity / c.fed.batch_size)))
-        num_steps = c.fed.local_epochs * steps_per_epoch
-    optimizer = local_lib.make_optimizer(c.fed.lr, c.fed.momentum)
-    update_fn = jax.jit(local_lib.make_local_update(
-        model_registry.build_model(c.model).apply, optimizer,
-        num_steps=num_steps, batch_size=c.fed.batch_size,
-        prox_mu=c.fed.prox_mu if c.fed.strategy == "fedprox" else 0.0,
-        min_steps_fraction=c.fed.straggler_min_fraction,
-    ))
+    local_update, num_steps = setup_lib.local_trainer_for_config(
+        c, model_registry.build_model(c.model).apply, shards.capacity
+    )
+    update_fn = jax.jit(local_update)
     key = prng.experiment_key(c.run.seed)
     result = update_fn(
         params,
@@ -133,6 +118,13 @@ def aggregate_updates(
     total_w = 0.0
     for p in update_paths:
         delta, umeta = load_pytree_npz(p)
+        # Guard against silent model corruption: an update computed against
+        # a different global round must not be folded in.
+        if "round" in umeta and int(umeta["round"]) != round_idx:
+            raise ValueError(
+                f"stale update {p}: computed at round {umeta['round']}, "
+                f"global model is at round {round_idx}"
+            )
         w = float(umeta.get("weight", 1.0))
         contrib = pytrees.tree_scale(delta, w)
         wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)
@@ -153,14 +145,18 @@ def aggregate_updates(
 
 def evaluate_global(config: ExperimentConfig, global_path: str,
                     dataset: Optional[data_registry.Dataset] = None) -> dict:
-    """Evaluator role (SURVEY.md §3d): score a global-model file."""
-    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+    """Evaluator role (SURVEY.md §3d): score a global-model file.
+
+    Builds only the model and the eval scan — no partitioning, no trainer,
+    no client data placement."""
+    from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
 
     params, meta = load_pytree_npz(global_path)
-    learner = FederatedLearner(config, dataset=dataset)
-    learner.server_state = learner.server_state._replace(
-        params=jax.tree.map(jnp.asarray, params)
-    )
-    loss, acc = learner.evaluate()
-    return {"round": int(meta.get("round", 0)), "eval_loss": loss,
-            "eval_acc": acc}
+    ds = dataset or data_registry.get_dataset(config.data.dataset,
+                                              seed=config.run.seed)
+    model = model_registry.build_model(config.model)
+    eval_fn = make_eval_fn(model.apply, ds.x_test, ds.y_test,
+                           batch=max(config.fed.batch_size, 64))
+    loss, acc = eval_fn(jax.tree.map(jnp.asarray, params))
+    return {"round": int(meta.get("round", 0)), "eval_loss": float(loss),
+            "eval_acc": float(acc)}

@@ -1,0 +1,61 @@
+"""Config → runtime pieces shared by the in-process engine and the
+cross-silo offline path.
+
+The same ExperimentConfig must produce the SAME partition, step budget and
+local trainer whether clients are simulated on-device (fed/engine.py) or
+run as decoupled silos against model files (fed/offline.py) — otherwise a
+silo trains differently from its simulated twin.  Both paths call these
+helpers instead of re-deriving the pieces.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from colearn_federated_learning_tpu.data import partition as partition_lib
+from colearn_federated_learning_tpu.fed import local as local_lib
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+def partition_for_config(
+    config: ExperimentConfig, labels: np.ndarray
+) -> list[np.ndarray]:
+    """Per-client index lists for ``config.data`` (iid | dirichlet)."""
+    c = config.data
+    if c.partition == "dirichlet":
+        return partition_lib.dirichlet_partition(
+            labels, c.num_clients, c.dirichlet_alpha, seed=config.run.seed
+        )
+    return partition_lib.iid_partition(
+        len(labels), c.num_clients, seed=config.run.seed
+    )
+
+
+def num_steps_for_config(config: ExperimentConfig, capacity: int) -> int:
+    """Static per-round local step budget: explicit ``local_steps`` or
+    ``local_epochs * ceil(capacity / batch_size)``."""
+    c = config.fed
+    if c.local_steps > 0:
+        return c.local_steps
+    steps_per_epoch = max(1, int(np.ceil(capacity / c.batch_size)))
+    return c.local_epochs * steps_per_epoch
+
+
+def local_trainer_for_config(
+    config: ExperimentConfig, apply_fn: Callable, capacity: int
+) -> tuple[Callable, int]:
+    """(local_update fn, num_steps) for one client round under ``config``."""
+    c = config.fed
+    num_steps = num_steps_for_config(config, capacity)
+    optimizer = local_lib.make_optimizer(c.lr, c.momentum)
+    update_fn = local_lib.make_local_update(
+        apply_fn,
+        optimizer,
+        num_steps=num_steps,
+        batch_size=c.batch_size,
+        prox_mu=c.prox_mu if c.strategy == "fedprox" else 0.0,
+        min_steps_fraction=c.straggler_min_fraction,
+    )
+    return update_fn, num_steps

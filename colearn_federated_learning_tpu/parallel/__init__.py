@@ -1,0 +1,21 @@
+"""Multi-chip parallelism: meshes, ring attention, sequence parallelism.
+
+The reference has no SPMD layer — its only parallelism is the federated
+round itself over TCP (SURVEY.md §2 "Parallelism strategies").  The rebuild
+is TPU-native, so scale comes from `jax.sharding` meshes instead:
+
+- ``mesh``:  named-axis mesh construction (clients × seq × model), ICI-first
+  with a DCN-aware hybrid layout for multi-host pods.
+- ``ring``:  ring attention — blockwise attention with K/V blocks rotating
+  around a mesh axis via ``lax.ppermute``, online-softmax accumulation; the
+  long-context sequence-parallel primitive.
+- ``sp``:    sequence-parallel transformer forward built on ``ring``.
+"""
+
+from colearn_federated_learning_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    factor_devices,
+)
+from colearn_federated_learning_tpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+)

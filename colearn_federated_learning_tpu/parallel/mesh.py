@@ -1,0 +1,84 @@
+"""Named-axis device mesh construction.
+
+The federated engine lays clients over a 1-D mesh (fed/engine.py); the
+sequence-parallel path wants a 2-D (clients, seq) mesh.  These helpers build
+both from whatever devices are visible, and — on multi-host pods — put the
+fastest-varying axes on ICI and the outermost axis on DCN, matching the
+"collectives ride ICI, not DCN" layout rule.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def factor_devices(n: int, num_axes: int) -> tuple[int, ...]:
+    """Factor ``n`` devices into ``num_axes`` mesh-axis sizes, largest first.
+
+    Greedy: peel off the largest power-of-two-ish divisor per axis so early
+    axes (typically the client/data axis) get the most devices.
+    """
+    if num_axes <= 0:
+        raise ValueError("num_axes must be >= 1")
+    sizes = []
+    remaining = n
+    for _ in range(num_axes - 1):
+        # smallest PROPER divisor > 1 for the trailing axes, so the leading
+        # axis keeps the bulk; primes (no proper divisor) give a size-1 axis
+        d = next((f for f in range(2, remaining) if remaining % f == 0), 1)
+        sizes.append(d)
+        remaining //= d
+    sizes.append(remaining)
+    return tuple(reversed(sizes))
+
+
+def make_mesh(
+    axis_names: Sequence[str],
+    axis_sizes: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named-axis :class:`jax.sharding.Mesh`.
+
+    - ``axis_sizes=None``: auto-factor all visible devices over the axes
+      (first axis largest).  A ``-1`` entry absorbs the remaining devices.
+    - Multi-host (``jax.process_count() > 1``): uses
+      ``mesh_utils.create_hybrid_device_mesh`` so the FIRST axis spans DCN
+      (one mesh row per host — the federated client axis tolerates slow
+      links because it only carries one psum per round) and the remaining
+      axes stay inside each host's ICI domain.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        sizes = list(factor_devices(n, len(axis_names)))
+    else:
+        sizes = list(axis_sizes)
+        if sizes.count(-1) > 1:
+            raise ValueError("at most one axis size may be -1")
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1]))
+            if known == 0 or n % known:
+                raise ValueError(f"cannot infer -1 axis: {n} devices over {sizes}")
+            sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"mesh {dict(zip(axis_names, sizes))} needs {int(np.prod(sizes))} "
+            f"devices, have {n}"
+        )
+
+    if jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+
+        per_host = n // jax.process_count()
+        if sizes[0] % jax.process_count() == 0 and per_host:
+            dcn = [jax.process_count()] + [1] * (len(sizes) - 1)
+            ici = [sizes[0] // jax.process_count()] + list(sizes[1:])
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici, dcn_mesh_shape=dcn, devices=devices
+            )
+            return Mesh(arr, tuple(axis_names))
+    return Mesh(np.array(devices).reshape(sizes), tuple(axis_names))

@@ -23,8 +23,18 @@ def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
     out: dict[str, np.ndarray] = {}
     if isinstance(tree, dict):
         for k in sorted(tree):
+            if "/" in str(k):
+                raise ValueError(f"key {k!r} contains the path separator '/'")
             out.update(_flatten(tree[k], f"{prefix}{k}/"))
         return out
+    if isinstance(tree, (list, tuple)):
+        # np.asarray would silently STACK a list of leaves into one array and
+        # the round trip would change tree structure; refuse loudly instead.
+        # (The wire format is dict-of-arrays; index lists/tuples by position.)
+        raise TypeError(
+            f"cannot serialize {type(tree).__name__} node at {prefix or '/'!r}: "
+            "convert to a dict with string keys first"
+        )
     out[prefix.rstrip("/")] = np.asarray(tree)
     return out
 

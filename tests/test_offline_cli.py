@@ -4,6 +4,7 @@ import dataclasses
 import json
 
 import numpy as np
+import pytest
 
 from colearn_federated_learning_tpu import cli
 from colearn_federated_learning_tpu.utils import serialization
@@ -89,3 +90,40 @@ def test_cli_cross_silo_flow(tmp_path, capsys):
 def test_cli_missing_client_args_errors():
     rc = cli.main(["train", "--role", "client"])
     assert rc == 2
+
+
+def test_aggregate_rejects_stale_update(tmp_path):
+    from colearn_federated_learning_tpu.fed import offline
+
+    cfg = tiny_config()
+    g0 = str(tmp_path / "g0.npz")
+    offline.init_global_model(cfg, g0)
+    u0 = str(tmp_path / "u0.npz")
+    offline.client_update(cfg, 0, g0, u0)
+    g1 = str(tmp_path / "g1.npz")
+    offline.aggregate_updates(cfg, g0, [u0], g1)
+    # u0 was computed against round 0; folding it into the round-1 model
+    # must fail loudly, not corrupt the model.
+    with pytest.raises(ValueError, match="stale update"):
+        offline.aggregate_updates(cfg, g1, [u0], str(tmp_path / "g2.npz"))
+
+
+def test_serialization_rejects_list_nodes(tmp_path):
+    with pytest.raises(TypeError, match="list"):
+        serialization.save_pytree_npz(
+            str(tmp_path / "x.npz"), {"layers": [np.zeros(3), np.zeros(3)]}
+        )
+
+
+def test_cli_bench_parses_forwarded_args(monkeypatch, capsys):
+    # `colearn bench` must forward its remaining argv to bench.main (it used
+    # to re-parse sys.argv and die on the 'bench' token); stub the heavy
+    # workload functions and check the wiring end-to-end.
+    from colearn_federated_learning_tpu import bench
+
+    monkeypatch.setattr(bench, "run_tpu_native",
+                        lambda rounds, warmup: {"rounds_per_sec": float(rounds)})
+    rc = cli.main(["bench", "--rounds", "3", "--skip-baseline"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 3.0 and rec["unit"] == "rounds/sec"
