@@ -1,0 +1,66 @@
+"""Multi-head attention with a pluggable core.
+
+The projections (q/k/v/out) are ordinary ``nn.DenseGeneral`` matmuls — the
+MXU work — and are IDENTICAL across cores, so the param pytree does not
+depend on which core computes the softmax:
+
+- ``dense``: single-device reference einsum (parallel/ring.py oracle).
+- ``flash``: Pallas blockwise kernel (ops/attention.py) — no (L, L) matrix
+  in HBM; interpret mode off-TPU.
+- ``ring``:  sequence-parallel ring attention — REQUIRES being called
+  inside ``shard_map`` with the sequence dim sharded over ``axis_name``
+  (parallel/sp.py drives this).
+
+Selected per-model via ``ModelConfig.attn_impl``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ATTN_IMPLS = ("dense", "flash", "ring")
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+    impl: str = "dense"
+    axis_name: Optional[str] = None   # mesh axis for impl="ring"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv_mask=None):
+        """x: (B, L, D); kv_mask: optional (B, L) bool, False = padding."""
+        D = x.shape[-1]
+        if D % self.num_heads:
+            raise ValueError(f"embed dim {D} not divisible by {self.num_heads} heads")
+        head_dim = D // self.num_heads
+
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(self.num_heads, head_dim), dtype=self.dtype, name=name
+        )
+        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+
+        if self.impl == "dense":
+            from colearn_federated_learning_tpu.parallel.ring import dense_attention
+
+            out = dense_attention(q, k, v, kv_mask, causal=self.causal)
+        elif self.impl == "flash":
+            from colearn_federated_learning_tpu.ops.attention import flash_attention
+
+            out = flash_attention(q, k, v, kv_mask, causal=self.causal)
+        elif self.impl == "ring":
+            from colearn_federated_learning_tpu.parallel.ring import ring_attention
+
+            if not self.axis_name:
+                raise ValueError("impl='ring' needs axis_name (a mesh axis)")
+            out = ring_attention(q, k, v, kv_mask, axis_name=self.axis_name,
+                                 causal=self.causal)
+        else:
+            raise ValueError(f"unknown attn impl {self.impl!r}; use {ATTN_IMPLS}")
+
+        return nn.DenseGeneral(features=D, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(out)

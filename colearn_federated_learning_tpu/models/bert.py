@@ -11,8 +11,12 @@ is masked out of both attention and pooling.  Sequence length is static
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax.numpy as jnp
+
+from colearn_federated_learning_tpu.models.attention import MultiHeadAttention
 
 
 class TransformerBlock(nn.Module):
@@ -20,13 +24,16 @@ class TransformerBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "dense"
+    attn_axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, attn_mask):
+    def __call__(self, x, pad_mask):
         # Post-LN (BERT-style): sublayer -> residual -> LayerNorm.
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, dtype=self.dtype, qkv_features=self.embed_dim
-        )(x, x, mask=attn_mask)
+        attn = MultiHeadAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            impl=self.attn_impl, axis_name=self.attn_axis_name,
+        )(x, pad_mask)
         x = nn.LayerNorm(dtype=self.dtype)(x + attn)
         h = nn.Dense(self.embed_dim * self.mlp_ratio, dtype=self.dtype)(x)
         h = nn.gelu(h)
@@ -42,6 +49,8 @@ class BertClassifier(nn.Module):
     num_heads: int = 12
     max_len: int = 128
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "dense"
+    attn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, ids, train: bool = False):
@@ -53,10 +62,11 @@ class BertClassifier(nn.Module):
         )
         x = tok + pos[:, :L].astype(self.dtype)
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        attn_mask = nn.make_attention_mask(pad_mask, pad_mask, dtype=self.dtype)
         for _ in range(self.depth):
-            x = TransformerBlock(self.embed_dim, self.num_heads, dtype=self.dtype)(
-                x, attn_mask
+            x = TransformerBlock(self.embed_dim, self.num_heads, dtype=self.dtype,
+                                 attn_impl=self.attn_impl,
+                                 attn_axis_name=self.attn_axis_name)(
+                x, pad_mask
             )
         # Masked mean pooling (no [CLS] convention in the synthetic corpus).
         m = pad_mask[..., None].astype(jnp.float32)

@@ -34,13 +34,14 @@ def build_model(cfg: ModelConfig):
         return BertClassifier(num_classes=cfg.num_classes, vocab_size=cfg.vocab_size,
                               embed_dim=cfg.width, depth=cfg.depth,
                               num_heads=cfg.num_heads, max_len=cfg.seq_len,
-                              dtype=dtype)
+                              dtype=dtype, attn_impl=cfg.attn_impl)
     if cfg.name == "vit_b16":
         from colearn_federated_learning_tpu.models.vit import ViT
 
         return ViT(num_classes=cfg.num_classes, embed_dim=cfg.width,
                    depth=cfg.depth, num_heads=cfg.num_heads,
-                   patch_size=cfg.patch_size, dtype=dtype)
+                   patch_size=cfg.patch_size, dtype=dtype,
+                   attn_impl=cfg.attn_impl)
     raise KeyError(f"unknown model {cfg.name!r}")
 
 

@@ -11,19 +11,22 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from colearn_federated_learning_tpu.models.attention import MultiHeadAttention
+
 
 class ViTBlock(nn.Module):
     embed_dim: int
     num_heads: int
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, dtype=self.dtype, qkv_features=self.embed_dim
-        )(y, y)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, dtype=self.dtype, impl=self.attn_impl
+        )(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)
         y = nn.Dense(self.embed_dim * self.mlp_ratio, dtype=self.dtype)(y)
@@ -39,6 +42,7 @@ class ViT(nn.Module):
     num_heads: int = 12
     patch_size: int = 16
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -58,7 +62,8 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(self.dtype)
         for _ in range(self.depth):
-            x = ViTBlock(self.embed_dim, self.num_heads, dtype=self.dtype)(x)
+            x = ViTBlock(self.embed_dim, self.num_heads, dtype=self.dtype,
+                         attn_impl=self.attn_impl)(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
         return logits

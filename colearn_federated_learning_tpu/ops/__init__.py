@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot ops.
+
+XLA already fuses the elementwise work around the framework's matmuls; the
+kernels here cover what fusion can't: ``attention`` implements blockwise
+flash attention (never materialises the (L, L) score matrix in HBM).  All
+kernels run in interpret mode on CPU so the virtual-mesh test suite
+exercises identical code paths.
+"""
+
+from colearn_federated_learning_tpu.ops.attention import (  # noqa: F401
+    flash_attention,
+)

@@ -1,0 +1,180 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise attention with online-softmax accumulation: each grid step owns
+one (batch·head, q-block) tile, keeps K/V VMEM-resident, and loops over
+k-blocks with running (max, normaliser, accumulator) carries — the (L, L)
+score matrix never exists in HBM, and the two matmuls per block land on the
+MXU.  The same rescaling recurrence runs ACROSS devices in
+parallel/ring.py; composing the two (ring outside, flash inside each block)
+is the standard long-context stack.
+
+Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
+pass recomputes attention densely from the (q, k, v, mask) residuals —
+exact gradients, forward-pass memory savings.  (A fused backward kernel is
+a future optimisation, not a correctness gap.)
+
+On CPU (the virtual-mesh test platform) the kernel runs in Pallas interpret
+mode automatically.
+
+The reference has no kernel layer at all (SURVEY.md §1: "no custom kernel
+layer"); this is TPU-native capability the rebuild adds for the BERT/ViT
+federated configs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+                  block_k: int, scale: float, causal: bool, block_q: int):
+    """One (batch·head, q-block) tile; K/V for the whole row are VMEM-resident.
+
+    q_ref: (1, block_q, D) — this tile's queries
+    k_ref, v_ref: (1, Lk, D) — all keys/values for this batch·head
+    bias_ref: (1, 1, Lk) — additive key bias (0 valid / _NEG masked); rank 3
+      so its block's trailing dims satisfy the TPU (8, 128) tiling rule
+    o_ref: (1, block_q, D)
+    """
+    Lk = k_ref.shape[1]
+    D = q_ref.shape[2]
+    num_kb = Lk // block_k
+    qb = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * scale                 # (bq, D)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bk)
+        s = s + bias_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+        if causal:
+            q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # Fully-masked blocks: m_new sits at the _NEG floor and exp(0)=1
+        # would leak padding; zero those entries (same fix as ring.py).
+        p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * corr + lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Skip k-blocks entirely above the diagonal.
+        num_kb = jnp.minimum(num_kb, pl.cdiv((qb + 1) * block_q, block_k))
+    m0 = jnp.full((q.shape[0], 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
+    acc0 = jnp.zeros((q.shape[0], D), jnp.float32)
+    m, l, acc = lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_impl(q, k, v, kv_mask, causal: bool,
+                block_q: int, block_k: int, interpret: Optional[bool]):
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bq = min(block_q, _round_up(Lq, 8))
+    bk = min(block_k, _round_up(Lk, 8))
+    Lq_p, Lk_p = _round_up(Lq, bq), _round_up(Lk, bk)
+
+    # (B, L, H, D) -> (B*H, L, D) rows; pad sequence to block multiples.
+    def to_rows(a, L_p):
+        a = jnp.pad(a, ((0, 0), (0, L_p - a.shape[1]), (0, 0), (0, 0)))
+        return a.transpose(0, 2, 1, 3).reshape(B * H, L_p, a.shape[-1])
+
+    qr, kr, vr = to_rows(q, Lq_p), to_rows(k, Lk_p), to_rows(v, Lk_p)
+    if kv_mask is None:
+        bias = jnp.zeros((B, Lk), jnp.float32)
+    else:
+        bias = jnp.where(kv_mask, 0.0, _NEG).astype(jnp.float32)
+    bias = jnp.pad(bias, ((0, 0), (0, Lk_p - Lk)), constant_values=_NEG)
+    bias = bias[:, None, :]                                   # (B, 1, Lk_p)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=bk, scale=1.0 / (D ** 0.5),
+        causal=causal, block_q=bq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Lq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Lk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Lk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Lk_p), lambda b, i: (b // H, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq_p, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, bias)
+    return out.reshape(B, H, Lq_p, D).transpose(0, 2, 1, 3)[:, :Lq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+    return _flash_impl(q, k, v, kv_mask, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+    out = _flash_impl(q, k, v, kv_mask, causal, block_q, block_k, interpret)
+    return out, (q, k, v, kv_mask)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    # Dense recompute from residuals: exact gradients, no stored (L, L)
+    # forward activations.
+    from colearn_federated_learning_tpu.parallel.ring import dense_attention
+
+    q, k, v, kv_mask = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: dense_attention(q, k, v, kv_mask, causal=causal),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: Optional[jax.Array] = None,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise (flash) attention over ``(B, L, H, D)`` tensors.
+
+    ``kv_mask``: optional ``(B, L_k)`` bool, False = padding key.  Fully
+    masked query rows return 0, matching ``dense_attention``.
+    ``interpret=None`` auto-selects Pallas interpret mode off-TPU.
+    """
+    return _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret)

@@ -35,6 +35,7 @@ class ModelConfig:
     seq_len: int = 128                # text models
     vocab_size: int = 30522           # BERT wordpiece vocab size
     dtype: str = "float32"            # compute dtype ("bfloat16" on TPU)
+    attn_impl: str = "dense"          # "dense" | "flash" (pallas) | "ring" (SP)
 
 
 @dataclasses.dataclass(frozen=True)
