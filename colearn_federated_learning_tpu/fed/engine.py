@@ -74,12 +74,20 @@ class FederatedLearner:
     ) -> "FederatedLearner":
         """Build a learner honoring ``config.run.backend`` (the CLI's
         ``--backend=tpu|cpu|auto``, BASELINE.json ``north_star``): resolve
-        devices, and if more than one is visible, lay clients over a
-        1-D mesh automatically."""
+        devices and lay clients over a 1-D mesh — or, with
+        ``attn_impl="ring"``, a 2-D (clients, seq) mesh where each client's
+        sequence dim is sharded over the inner (ICI-fastest) axis."""
+        from colearn_federated_learning_tpu.parallel.mesh import make_mesh
+
         devices = _resolve_devices(config.run.backend)
         mesh = None
         if len(devices) > 1:
-            mesh = Mesh(np.array(devices), (config.run.mesh_axis,))
+            if config.model.attn_impl == "ring":
+                mesh = make_mesh(
+                    (config.run.mesh_axis, config.run.seq_axis), devices=devices
+                )
+            else:
+                mesh = Mesh(np.array(devices), (config.run.mesh_axis,))
         return cls(config, dataset=dataset, mesh=mesh)
 
     def __init__(
@@ -92,6 +100,38 @@ class FederatedLearner:
         self.mesh = mesh
         c = config
 
+        # --- mesh axes ------------------------------------------------
+        # 1-D mesh: clients only.  2-D mesh (attn_impl="ring"): clients on
+        # the outer axis, each client's sequence dim sharded over the inner
+        # ``seq`` axis (sequence parallelism; parallel/ring.py).
+        self.client_axis = c.run.mesh_axis
+        self.seq_axis = c.run.seq_axis
+        if mesh is not None:
+            if self.client_axis not in mesh.shape:
+                raise ValueError(
+                    f"mesh axes {tuple(mesh.shape)} lack the client axis "
+                    f"{self.client_axis!r}"
+                )
+            self.clients_size = mesh.shape[self.client_axis]
+            self.seq_size = mesh.shape.get(self.seq_axis, 1)
+            extra = set(mesh.shape) - {self.client_axis, self.seq_axis}
+            if extra:
+                raise ValueError(f"unsupported mesh axes {sorted(extra)}")
+        else:
+            self.clients_size = 1
+            self.seq_size = 1
+        self.sp = self.seq_size > 1
+        if self.sp and c.model.attn_impl != "ring":
+            raise ValueError(
+                f"a {self.seq_size}-way {self.seq_axis!r} mesh axis requires "
+                "model.attn_impl='ring'"
+            )
+        if c.model.attn_impl == "ring" and mesh is not None and not self.sp:
+            raise ValueError(
+                "attn_impl='ring' on a mesh requires a "
+                f"{self.seq_axis!r} axis of size > 1"
+            )
+
         # --- data -----------------------------------------------------
         self.dataset = dataset or data_registry.get_dataset(
             c.data.dataset, seed=c.run.seed
@@ -103,14 +143,26 @@ class FederatedLearner:
             capacity=c.data.max_examples_per_client,
         )
         self.real_num_clients = shards.num_clients
+        if self.sp:
+            seq_len = shards.x.shape[-1]
+            if shards.x.ndim != 3:
+                raise ValueError(
+                    "sequence parallelism needs (tokens,)-shaped examples, "
+                    f"got example shape {shards.x.shape[2:]}"
+                )
+            if seq_len % self.seq_size:
+                raise ValueError(
+                    f"seq_len {seq_len} is not divisible by the "
+                    f"{self.seq_size}-way {self.seq_axis!r} axis"
+                )
         if mesh is not None:
-            shards = pad_clients_to_multiple(shards, mesh.devices.size)
+            shards = pad_clients_to_multiple(shards, self.clients_size)
             # Interleave so real clients spread evenly across devices (ghost
             # padding would otherwise pile onto the last devices and starve
             # their per-device cohorts).  ``client_ids[slot]`` is the
             # ORIGINAL client identity of each array slot; all PRNG is keyed
             # on it, keeping results placement-independent.
-            D = mesh.devices.size
+            D = self.clients_size
             L = shards.num_clients // D
             order = np.array(
                 [j * D + d for d in range(D) for j in range(L)], dtype=np.int32
@@ -125,22 +177,40 @@ class FederatedLearner:
         self.num_clients = shards.num_clients
 
         # --- model ----------------------------------------------------
-        self.model = model_registry.build_model(c.model)
+        # Under SP the trained module runs on sequence SHARDS inside
+        # shard_map; its dense-attention twin (identical param pytree) is
+        # used for init and full-sequence evaluation outside the mesh.
+        import dataclasses
+
+        train_model_cfg = c.model
+        if c.model.attn_impl == "ring" and not self.sp:
+            # Single-device run of an SP config: same params, dense core.
+            train_model_cfg = dataclasses.replace(c.model, attn_impl="dense")
+        self.model = model_registry.build_model(
+            train_model_cfg, seq_axis_name=self.seq_axis if self.sp else None
+        )
+        if self.sp:
+            self.eval_model = model_registry.build_model(
+                dataclasses.replace(c.model, attn_impl="dense")
+            )
+        else:
+            self.eval_model = self.model
         example_x = jnp.asarray(shards.x[0, : c.fed.batch_size])
         ikey = prng.init_key(prng.experiment_key(c.run.seed))
-        self.params = model_registry.init_params(self.model, example_x, ikey)
+        self.params = model_registry.init_params(self.eval_model, example_x, ikey)
         self.server_state = strategies.init_server_state(self.params, c.fed)
 
         # --- local trainer -------------------------------------------
         self.local_update, self.num_steps = setup_lib.local_trainer_for_config(
-            c, self.model.apply, shards.capacity
+            c, self.model.apply, shards.capacity,
+            grad_sync_axes=(self.seq_axis,) if self.sp else (),
         )
 
         # --- cohort ---------------------------------------------------
         cohort = c.fed.cohort_size or self.num_clients
         self.cohort_size = min(cohort, self.num_clients)
         if mesh is not None:
-            d = mesh.devices.size
+            d = self.clients_size
             # per-device cohort must be equal and static
             self.cohort_per_device = max(1, self.cohort_size // d)
             adjusted = self.cohort_per_device * d
@@ -149,7 +219,7 @@ class FederatedLearner:
 
                 warnings.warn(
                     f"cohort_size={self.cohort_size} is not a multiple of the "
-                    f"{d}-device mesh; using {adjusted} "
+                    f"{d}-way client axis; using {adjusted} "
                     f"({self.cohort_per_device}/device)",
                     stacklevel=2,
                 )
@@ -177,9 +247,15 @@ class FederatedLearner:
         counts = jnp.asarray(self.shards.counts)
         ids = jnp.asarray(self.client_ids)
         if self.mesh is not None:
-            ax = self.config.run.mesh_axis
+            ax = self.client_axis
+            # Under SP each client's token dim is also sharded (last axis of
+            # the (clients, capacity, seq_len) block).
+            x_spec = (
+                P(ax, None, self.seq_axis) if self.sp else P(ax)
+            )
+            x = jax.device_put(x, NamedSharding(self.mesh, x_spec))
             sh = NamedSharding(self.mesh, P(ax))
-            x, y, counts, ids = (jax.device_put(a, sh) for a in (x, y, counts, ids))
+            y, counts, ids = (jax.device_put(a, sh) for a in (y, counts, ids))
         return (x, y, counts, ids)
 
     # ------------------------------------------------------------------
@@ -311,10 +387,13 @@ class FederatedLearner:
 
             return round_fn
 
-        # ---- multi-chip: shard_map over the client axis --------------
+        # ---- multi-chip: shard_map over the client axis (and, under SP,
+        # the sequence axis — every collective below names ONLY the client
+        # axis, so the ring collectives inside the model stay on ``seq``).
         mesh = self.mesh
+        ax = self.client_axis
         self.cohort_size_local = self.cohort_per_device
-        local_clients = self.num_clients // mesh.devices.size
+        local_clients = self.num_clients // self.clients_size
 
         def body(server_state, key, round_idx, x_blk, y_blk, counts_blk, ids_blk):
             dev = jax.lax.axis_index(ax)
@@ -344,10 +423,11 @@ class FederatedLearner:
             return self._finish_round(server_state, wsum, total_w,
                                       loss_sum, n_comp)
 
+        x_spec = P(ax, None, self.seq_axis) if self.sp else P(ax)
         sharded = shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(ax), P(ax), P(ax), P(ax)),
+            in_specs=(P(), P(), P(), x_spec, P(ax), P(ax), P(ax)),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -358,7 +438,7 @@ class FederatedLearner:
     # ------------------------------------------------------------------
     def _build_eval_fn(self):
         return make_eval_fn(
-            self.model.apply,
+            self.eval_model.apply,
             self.dataset.x_test,
             self.dataset.y_test,
             batch=max(self.config.fed.batch_size, 64),

@@ -55,6 +55,7 @@ def make_local_update(
     batch_size: int,
     prox_mu: float = 0.0,
     min_steps_fraction: float = 0.25,
+    grad_sync_axes: tuple[str, ...] = (),
 ) -> Callable:
     """Build ``local_update(global_params, x, y, count, key, step_budget)``.
 
@@ -63,20 +64,28 @@ def make_local_update(
     - Sampling: each step draws ``batch_size`` uniform indices in
       [0, count) — i.i.d. sampling-with-replacement, the standard choice for
       static-shape federated simulation.
+    - ``grad_sync_axes``: mesh axes the model's activations are sharded
+      over (sequence parallelism).  Per-step grads are pmean'd over them —
+      paired with the model's ``psum_for_grad_pmean`` pooling collective
+      (parallel/collectives.py) this reconstructs exact full-sequence grads
+      on every shard, so params stay replicated through local training.
     """
     min_steps = max(1, int(num_steps * min_steps_fraction))
 
     def loss_fn(params, global_params, xb, yb):
         logits = apply_fn({"params": params}, xb, train=True)
-        loss = losses.softmax_cross_entropy(logits, yb)
+        ce = losses.softmax_cross_entropy(logits, yb)
+        loss = ce
         if prox_mu > 0.0:
             # FedProx: + μ/2 ‖w − w_global‖² (BASELINE config #3, μ=0.01)
+            # FedProx grads flow through the (replicated) params on every
+            # shard; under the pmean convention that is already exact.
             loss = loss + 0.5 * prox_mu * pytrees.tree_sq_norm(
                 pytrees.tree_sub(params, global_params)
             )
-        return loss
+        return loss, ce
 
-    grad_fn = jax.value_and_grad(loss_fn)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def local_update(global_params, x, y, count, key, step_budget):
         opt_state = optimizer.init(global_params)
@@ -88,7 +97,9 @@ def make_local_update(
             idx = jax.random.randint(k, (batch_size,), 0, safe_count)
             xb = jnp.take(x, idx, axis=0)
             yb = jnp.take(y, idx, axis=0)
-            loss, grads = grad_fn(params, global_params, xb, yb)
+            (_, loss), grads = grad_fn(params, global_params, xb, yb)
+            for ax in grad_sync_axes:
+                grads = jax.tree.map(lambda g: jax.lax.pmean(g, ax), grads)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             active = t < step_budget

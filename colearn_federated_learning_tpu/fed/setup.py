@@ -44,9 +44,14 @@ def num_steps_for_config(config: ExperimentConfig, capacity: int) -> int:
 
 
 def local_trainer_for_config(
-    config: ExperimentConfig, apply_fn: Callable, capacity: int
+    config: ExperimentConfig,
+    apply_fn: Callable,
+    capacity: int,
+    grad_sync_axes: tuple[str, ...] = (),
 ) -> tuple[Callable, int]:
-    """(local_update fn, num_steps) for one client round under ``config``."""
+    """(local_update fn, num_steps) for one client round under ``config``.
+
+    ``grad_sync_axes``: sequence-parallel mesh axes (fed/local.py)."""
     c = config.fed
     num_steps = num_steps_for_config(config, capacity)
     optimizer = local_lib.make_optimizer(c.lr, c.momentum)
@@ -57,5 +62,6 @@ def local_trainer_for_config(
         batch_size=c.batch_size,
         prox_mu=c.prox_mu if c.strategy == "fedprox" else 0.0,
         min_steps_fraction=c.straggler_min_fraction,
+        grad_sync_axes=grad_sync_axes,
     )
     return update_fn, num_steps

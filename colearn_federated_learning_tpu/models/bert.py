@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from colearn_federated_learning_tpu.models.attention import MultiHeadAttention
@@ -50,26 +51,53 @@ class BertClassifier(nn.Module):
     max_len: int = 128
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "dense"
-    attn_axis_name: Optional[str] = None
+    seq_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, ids, train: bool = False):
+        """``ids``: (B, L) token ids.
+
+        Sequence parallelism: with ``seq_axis_name`` set (and
+        ``attn_impl="ring"``) the module runs inside ``shard_map`` on a
+        local (B, L/S) shard — position embeddings are sliced at this
+        shard's GLOBAL offset, attention rings over the axis, and the
+        masked-mean pooling finishes with a psum so logits come out
+        replicated across the sequence axis.
+        """
         B, L = ids.shape
+        sp = self.seq_axis_name
         pad_mask = (ids != 0)                                  # (B, L)
         tok = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.dtype)(ids)
         pos = self.param(
             "pos_embed", nn.initializers.normal(0.02), (1, self.max_len, self.embed_dim)
         )
-        x = tok + pos[:, :L].astype(self.dtype)
+        if sp is not None:
+            offset = jax.lax.axis_index(sp) * L
+            pos_l = jax.lax.dynamic_slice_in_dim(pos, offset, L, axis=1)
+        else:
+            pos_l = pos[:, :L]
+        x = tok + pos_l.astype(self.dtype)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         for _ in range(self.depth):
             x = TransformerBlock(self.embed_dim, self.num_heads, dtype=self.dtype,
                                  attn_impl=self.attn_impl,
-                                 attn_axis_name=self.attn_axis_name)(
+                                 attn_axis_name=sp)(
                 x, pad_mask
             )
-        # Masked mean pooling (no [CLS] convention in the synthetic corpus).
+        # Masked mean pooling (no [CLS] convention in the synthetic corpus);
+        # under SP the token sums finish with a psum over the sequence axis
+        # whose grad convention pairs with the trainer's pmean (see
+        # parallel/collectives.py).
         m = pad_mask[..., None].astype(jnp.float32)
-        pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        sum_x = (x.astype(jnp.float32) * m).sum(1)
+        sum_m = m.sum(1)
+        if sp is not None:
+            from colearn_federated_learning_tpu.parallel.collectives import (
+                psum_for_grad_pmean,
+            )
+
+            sum_x = psum_for_grad_pmean(sum_x, sp)
+            sum_m = jax.lax.psum(sum_m, sp)  # mask: no grad
+        pooled = sum_x / jnp.maximum(sum_m, 1.0)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
         return logits

@@ -12,9 +12,18 @@ def _dtype(cfg: ModelConfig):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.dtype]
 
 
-def build_model(cfg: ModelConfig):
-    """Return the flax module for a ModelConfig."""
+def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
+    """Return the flax module for a ModelConfig.
+
+    ``seq_axis_name``: mesh axis for sequence parallelism — only meaningful
+    for text models with ``attn_impl="ring"``, which must then be applied
+    inside ``shard_map`` with the sequence dim sharded over that axis.
+    """
     dtype = _dtype(cfg)
+    if seq_axis_name is not None and cfg.name != "bert":
+        raise ValueError(
+            f"sequence parallelism is only supported for 'bert', not {cfg.name!r}"
+        )
     if cfg.name == "mlp":
         from colearn_federated_learning_tpu.models.mlp import MLP
 
@@ -34,7 +43,8 @@ def build_model(cfg: ModelConfig):
         return BertClassifier(num_classes=cfg.num_classes, vocab_size=cfg.vocab_size,
                               embed_dim=cfg.width, depth=cfg.depth,
                               num_heads=cfg.num_heads, max_len=cfg.seq_len,
-                              dtype=dtype, attn_impl=cfg.attn_impl)
+                              dtype=dtype, attn_impl=cfg.attn_impl,
+                              seq_axis_name=seq_axis_name)
     if cfg.name == "vit_b16":
         from colearn_federated_learning_tpu.models.vit import ViT
 

@@ -1,0 +1,46 @@
+"""Collectives with gradient conventions for sequence parallelism.
+
+Under SP a model has two parameter regions:
+- the TOKEN path (embeddings, transformer blocks): forward consumes
+  sequence SHARDS, so each device's grad is a PARTIAL sum that must be
+  summed across the axis;
+- the REPLICATED path (anything after the pooling psum, e.g. the
+  classifier head): forward is identical on every device, so each
+  device's grad is already the FULL grad and summing would scale it by S.
+
+Rather than classifying params, we fix the convention at the single choke
+point where the two regions meet: ``psum_for_grad_pmean`` is a psum whose
+backward multiplies the cotangent by the axis size S.  Pair it with a
+plain ``lax.pmean`` over ALL grads:
+
+  token path:  (partial · S)  --pmean-->  Σ partial      = full ✓
+  replicated:  full           --pmean-->  full           = full ✓
+
+fed/local.py and parallel/sp.py apply the pmean; models insert this psum
+at their pooling/reduction boundary (models/bert.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_for_grad_pmean(x, axis_name: str):
+    """``lax.psum(x, axis_name)`` whose backward is also a psum (=S·cot)."""
+    return lax.psum(x, axis_name)
+
+
+def _fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _bwd(axis_name, _, g):
+    # g is replicated across the axis, so psum(g) == S * g.
+    return (lax.psum(g, axis_name),)
+
+
+psum_for_grad_pmean.defvjp(_fwd, _bwd)

@@ -70,6 +70,7 @@ class RunConfig:
     seed: int = 0
     backend: str = "auto"             # "auto" | "tpu" | "cpu"  (CLI --backend)
     mesh_axis: str = "clients"
+    seq_axis: str = "seq"             # sequence-parallel axis (attn_impl="ring")
     log_every: int = 1
     eval_every: int = 1
     checkpoint_dir: Optional[str] = None

@@ -1,0 +1,134 @@
+"""Sequence parallelism: SP BERT (ring attention, sharded positions,
+psum pooling) must match its dense-attention twin — forward, grads, and a
+full federated round on a 2-D (clients, seq) mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.fed.losses import softmax_cross_entropy
+from colearn_federated_learning_tpu.models import registry as model_registry
+from colearn_federated_learning_tpu.parallel.mesh import make_mesh
+from colearn_federated_learning_tpu.parallel.sp import (
+    make_sp_apply,
+    make_sp_loss_grad,
+)
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+BERT_CFG = ModelConfig(name="bert", num_classes=4, width=32, depth=2,
+                       num_heads=4, seq_len=32, vocab_size=200)
+
+
+def _models_and_params():
+    dense = model_registry.build_model(BERT_CFG)
+    sp = model_registry.build_model(
+        dataclasses.replace(BERT_CFG, attn_impl="ring"), seq_axis_name="seq"
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 32), 0, 200)
+    params = model_registry.init_params(dense, ids, jax.random.PRNGKey(1))
+    return dense, sp, ids, params
+
+
+def test_sp_forward_matches_dense(cpu_devices):
+    mesh = make_mesh(("seq",), (4,), devices=cpu_devices[:4])
+    dense, sp, ids, params = _models_and_params()
+    y_ref = dense.apply({"params": params}, ids, train=False)
+    y_sp = make_sp_apply(sp, mesh)(params, ids)
+    np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sp_grads_match_dense(cpu_devices):
+    mesh = make_mesh(("seq",), (4,), devices=cpu_devices[:4])
+    dense, sp, ids, params = _models_and_params()
+    labels = jnp.array([0, 1, 2, 3])
+
+    def dense_loss(p):
+        return softmax_cross_entropy(
+            dense.apply({"params": p}, ids, train=True), labels
+        )
+
+    l_ref, g_ref = jax.value_and_grad(dense_loss)(params)
+    l_sp, g_sp = make_sp_loss_grad(sp, softmax_cross_entropy, mesh)(
+        params, ids, labels
+    )
+    np.testing.assert_allclose(float(l_sp), float(l_ref), rtol=1e-5)
+    flat_ref = jax.tree.leaves(g_ref)
+    flat_sp = jax.tree.leaves(g_sp)
+    for a, b in zip(flat_sp, flat_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def _sp_exp_config(attn_impl="ring"):
+    return ExperimentConfig(
+        data=DataConfig(dataset="agnews_tiny", num_clients=8, partition="iid",
+                        max_examples_per_client=64),
+        model=dataclasses.replace(
+            BERT_CFG, seq_len=64, vocab_size=2000, attn_impl=attn_impl),
+        # Full participation (cohort = all clients): mesh and single-device
+        # paths then train the SAME cohort, so results must agree.
+        fed=FedConfig(strategy="fedavg", rounds=2, cohort_size=0,
+                      local_steps=2, batch_size=8, lr=0.1, momentum=0.9),
+        run=RunConfig(name="sp_test", backend="cpu"),
+    )
+
+
+def test_federated_round_on_2d_mesh_matches_single_device(cpu_devices):
+    mesh = make_mesh(("clients", "seq"), (4, 2), devices=cpu_devices[:8])
+    sp_learner = FederatedLearner(_sp_exp_config(), mesh=mesh)
+    assert sp_learner.sp and sp_learner.seq_size == 2
+    ref_learner = FederatedLearner(_sp_exp_config(attn_impl="dense"))
+
+    m_sp = sp_learner.run_round()
+    m_ref = ref_learner.run_round()
+    assert m_sp["completed"] == m_ref["completed"] == 8
+    np.testing.assert_allclose(m_sp["train_loss"], m_ref["train_loss"],
+                               rtol=5e-3)
+    # eval runs the dense twin on the full sequence
+    loss_sp, acc_sp = sp_learner.evaluate()
+    loss_ref, acc_ref = ref_learner.evaluate()
+    np.testing.assert_allclose(loss_sp, loss_ref, rtol=5e-3)
+    assert abs(acc_sp - acc_ref) < 0.05
+
+
+def test_sp_requires_divisible_seq(cpu_devices):
+    mesh = make_mesh(("clients", "seq"), (2, 4), devices=cpu_devices[:8])
+    cfg = _sp_exp_config()
+    cfg = cfg.replace(model=dataclasses.replace(cfg.model, seq_len=30))
+    # agnews_tiny examples are 64 tokens; fake a bad split by a 4-way axis
+    # over a 30-token model is moot — instead check the engine's guard on
+    # the real shard shape: 64 % 4 == 0 passes, so use a 3-way-impossible
+    # mesh via direct Mesh of 5 devices? Simplest: 64 tokens over seq=4 is
+    # fine; assert the error path with a dataset whose seq isn't divisible.
+    import numpy as onp
+
+    from colearn_federated_learning_tpu.data.registry import Dataset, DatasetSpec
+
+    spec = DatasetSpec("odd_text", "text", (30,), 4, 64, 16, vocab_size=2000)
+    ds = Dataset(
+        spec=spec,
+        x_train=onp.ones((64, 30), onp.int32), y_train=onp.zeros(64, onp.int32),
+        x_test=onp.ones((16, 30), onp.int32), y_test=onp.zeros(16, onp.int32),
+        source="synthetic",
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        FederatedLearner(cfg, dataset=ds, mesh=mesh)
+
+
+def test_ring_config_single_device_falls_back_to_dense():
+    learner = FederatedLearner(_sp_exp_config())  # no mesh
+    assert not learner.sp
+    learner.run_round()
+    assert np.isfinite(learner.history[-1]["train_loss"])
