@@ -66,6 +66,16 @@ _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
+    """Resolve the experiment config and — BEFORE jax initializes a
+    backend — honor ``--backend=cpu`` (env vars alone don't override a
+    platform pinned by the host's sitecustomize)."""
+    if getattr(args, "backend", None) == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass                      # backend already initialized
     cfg = get_config(args.config)
     sections = {"fed": {}, "data": {}, "run": {}}
     for key, val in vars(args).items():
@@ -148,6 +158,52 @@ def cmd_eval(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_broker(args: argparse.Namespace) -> int:
+    import threading
+
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+
+    broker = MessageBroker(host=args.host, port=args.port).start()
+    print(json.dumps({"host": broker.host, "port": broker.port}), flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        broker.stop()
+    return 0
+
+
+def cmd_worker(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu.comm.worker import run_worker_forever
+
+    config = config_from_args(args)
+    if args.client_id is None:
+        print("worker requires --client-id", file=sys.stderr)
+        return 2
+    run_worker_forever(config, args.client_id, args.broker_host,
+                       args.broker_port)
+    return 0
+
+
+def cmd_coordinate(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu.comm.coordinator import (
+        FederatedCoordinator,
+    )
+
+    config = config_from_args(args)
+    coord = FederatedCoordinator(config, args.broker_host, args.broker_port,
+                                 round_timeout=args.round_timeout,
+                                 want_evaluator=not args.no_evaluator)
+    with coord:
+        coord.enroll(min_devices=args.min_devices,
+                     timeout=args.enroll_timeout)
+        hist = coord.fit(log_fn=lambda rec: print(json.dumps(rec),
+                                                  file=sys.stderr))
+        print(json.dumps(hist[-1]))
+    return 0
+
+
 def cmd_configs(_args: argparse.Namespace) -> int:
     for name, cfg in sorted(CONFIGS.items()):
         print(f"{name}: {cfg.model.name} on {cfg.data.dataset}, "
@@ -201,6 +257,32 @@ def main(argv: list[str] | None = None) -> int:
 
     sub.add_parser("configs", help="list experiment configs").set_defaults(
         fn=cmd_configs)
+    p_broker = sub.add_parser("broker", help="run the pub/sub control-plane "
+                                             "broker (MQTT equivalent)")
+    p_broker.add_argument("--host", default="127.0.0.1")
+    p_broker.add_argument("--port", type=int, default=0)
+    p_broker.set_defaults(fn=cmd_broker)
+
+    p_worker = sub.add_parser("worker", help="run a device worker process "
+                                             "(shard + local trainer)")
+    _add_override_flags(p_worker)
+    p_worker.add_argument("--client-id", type=int, default=None)
+    p_worker.add_argument("--broker-host", default="127.0.0.1")
+    p_worker.add_argument("--broker-port", type=int, required=True)
+    p_worker.set_defaults(fn=cmd_worker)
+
+    p_coord = sub.add_parser("coordinate",
+                             help="run the federated coordinator over "
+                                  "enrolled workers")
+    _add_override_flags(p_coord)
+    p_coord.add_argument("--broker-host", default="127.0.0.1")
+    p_coord.add_argument("--broker-port", type=int, required=True)
+    p_coord.add_argument("--min-devices", type=int, default=2)
+    p_coord.add_argument("--enroll-timeout", type=float, default=60.0)
+    p_coord.add_argument("--round-timeout", type=float, default=120.0)
+    p_coord.add_argument("--no-evaluator", action="store_true")
+    p_coord.set_defaults(fn=cmd_coordinate)
+
     p_bench = sub.add_parser("bench", help="run the headline benchmark")
     p_bench.add_argument("--rounds", type=int, default=20)
     p_bench.add_argument("--warmup", type=int, default=2)

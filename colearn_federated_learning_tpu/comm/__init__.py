@@ -1,0 +1,28 @@
+"""Cross-process federation: control plane + tensor plane.
+
+The reference federates REAL devices: a paho-mqtt broker carries device
+enrollment / role negotiation, and PySyft websocket workers carry tensors
+(SURVEY.md §1 "Enrollment / discovery" and "Communication").  The rebuild
+keeps that two-plane architecture with zero external dependencies:
+
+- ``protocol``:   length-prefixed JSON-header + binary-body framing.
+- ``broker``:     tiny TCP pub/sub broker (the MQTT equivalent).
+- ``enrollment``: device announce → coordinator selects trainer/evaluator
+  roles (the reference's MQTT topic negotiation).
+- ``transport``:  per-device tensor server/client moving model pytrees
+  (the PySyft websocket-worker equivalent).
+- ``worker``:     device process — local shard + jit local trainer.
+- ``coordinator``: round loop over enrolled devices with per-round
+  timeouts (straggler drop), server strategies, evaluator scoring.
+
+On-device simulation (fed/engine.py) is the fast path; this package is the
+cross-silo path where participants are separate processes/hosts.  Both use
+the same config, trainer construction (fed/setup.py) and wire payloads
+(utils/serialization.py npz), so a silo can move between modes freely.
+"""
+
+from colearn_federated_learning_tpu.comm.broker import MessageBroker  # noqa: F401
+from colearn_federated_learning_tpu.comm.coordinator import (  # noqa: F401
+    FederatedCoordinator,
+)
+from colearn_federated_learning_tpu.comm.worker import DeviceWorker  # noqa: F401

@@ -1,0 +1,208 @@
+"""Tiny TCP pub/sub broker — the control plane's MQTT stand-in.
+
+The reference enrolls devices through an external MQTT broker (paho-mqtt
+``on_connect``/``on_message`` handlers, SURVEY.md §2 "MQTT enrollment
+manager").  The rebuild ships its own dependency-free broker speaking the
+framing in protocol.py:
+
+- ``{"op": "sub", "topic": t}``  — subscribe this connection to ``t``;
+  a trailing ``#`` subscribes to the whole prefix (MQTT-style wildcard).
+- ``{"op": "pub", "topic": t, ...}`` + body — fan out to all subscribers.
+- Messages retain their extra header fields and body verbatim.
+
+Topics with a retained last message (``"retain": true`` on publish) replay
+it to late subscribers — used for role assignments so a device that
+subscribes after selection still learns its role.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Optional
+
+from colearn_federated_learning_tpu.comm import protocol
+
+
+def _match(pattern: str, topic: str) -> bool:
+    if pattern.endswith("#"):
+        return topic.startswith(pattern[:-1])
+    return pattern == topic
+
+
+class MessageBroker:
+    """Threaded pub/sub broker on localhost.  ``port=0`` picks a free port
+    (read it back from ``.port``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()
+        self._lock = threading.Lock()
+        self._subs: dict[socket.socket, list[str]] = {}
+        # Per-socket write locks: publisher threads fan out concurrently and
+        # protocol frames must never interleave on a subscriber's stream.
+        self._wlocks: dict[socket.socket, threading.Lock] = {}
+        self._retained: dict[str, tuple[dict, bytes]] = {}
+        self._stopping = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "MessageBroker":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="broker-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = list(self._subs)
+            self._subs.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._wlocks[conn] = threading.Lock()
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="broker-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                header, body = protocol.recv_msg(conn)
+                op = header.get("op")
+                if op == "sub":
+                    self._subscribe(conn, header["topic"])
+                elif op == "pub":
+                    self._publish(header, body)
+                elif op == "ping":
+                    self._send(conn, {"op": "pong"}, b"")
+        except (protocol.ConnectionClosed, OSError, ValueError):
+            pass
+        finally:
+            with self._lock:
+                self._subs.pop(conn, None)
+                self._wlocks.pop(conn, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send(self, conn: socket.socket, header: dict, body: bytes) -> None:
+        with self._lock:
+            wlock = self._wlocks.get(conn)
+        if wlock is None:
+            return
+        try:
+            with wlock:
+                protocol.send_msg(conn, header, body)
+        except OSError:
+            pass
+
+    def _subscribe(self, conn: socket.socket, pattern: str) -> None:
+        with self._lock:
+            self._subs.setdefault(conn, []).append(pattern)
+            replay = [
+                (dict(h), b) for t, (h, b) in self._retained.items()
+                if _match(pattern, t)
+            ]
+        for h, b in replay:
+            self._send(conn, h, b)
+
+    def _publish(self, header: dict, body: bytes) -> None:
+        topic = header["topic"]
+        out = {k: v for k, v in header.items() if k not in ("op", "retain")}
+        out["op"] = "msg"
+        with self._lock:
+            if header.get("retain"):
+                self._retained[topic] = (out, body)
+            targets = [
+                s for s, pats in self._subs.items()
+                if any(_match(p, topic) for p in pats)
+            ]
+        for s in targets:
+            self._send(s, out, body)
+
+
+class BrokerClient:
+    """One connection to the broker: publish anywhere, receive subscribed
+    messages via ``recv(timeout=...)``.
+
+    A dedicated reader thread drains frames into a queue, so a consumer
+    timeout can NEVER strand the socket mid-frame (a plain socket timeout
+    inside a length-prefixed read would desynchronise the stream for
+    good)."""
+
+    def __init__(self, host: str, port: int, timeout: Optional[float] = None):
+        self._sock = protocol.connect(host, port, timeout=timeout)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._q: "queue.Queue[Optional[tuple[dict, bytes]]]" = queue.Queue()
+        self._reader = threading.Thread(
+            target=self._read_loop, name="broker-client-read", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                self._q.put(protocol.recv_msg(self._sock))
+        except (protocol.ConnectionClosed, OSError, ValueError):
+            self._q.put(None)                 # sentinel: connection is gone
+
+    def subscribe(self, topic: str) -> None:
+        with self._wlock:
+            protocol.send_msg(self._sock, {"op": "sub", "topic": topic})
+
+    def publish(self, topic: str, fields: Optional[dict] = None,
+                body: bytes = b"", retain: bool = False) -> None:
+        header = {"op": "pub", "topic": topic, **(fields or {})}
+        if retain:
+            header["retain"] = True
+        with self._wlock:
+            protocol.send_msg(self._sock, header, body)
+
+    def recv(self, timeout: Optional[float] = None) -> tuple[dict, bytes]:
+        """Next message on any subscribed topic.  Raises ``TimeoutError``
+        after ``timeout`` seconds, ``ConnectionClosed`` on a dead broker."""
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError("no broker message") from None
+        if item is None:
+            raise protocol.ConnectionClosed("broker connection closed")
+        return item
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

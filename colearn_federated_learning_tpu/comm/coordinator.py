@@ -1,0 +1,181 @@
+"""Federated coordinator over the socket planes.
+
+The reference's coordinator (SURVEY.md §3a) connects to the MQTT broker,
+collects ready devices, selects trainers/evaluators, then per round:
+serialize global weights → websocket to each trainer → await updates →
+host-side ``fed_avg`` → evaluator scoring.  This class is that loop over
+the in-tree broker + tensor transport, with three upgrades:
+
+- per-round REQUEST TIMEOUTS: a device that fails or is too slow is
+  dropped from this round's weighted average (straggler handling,
+  SURVEY.md §5 "failure detection") and the round completes without it;
+- the aggregation step and server optimizers are the SAME
+  fed/strategies.py code the on-device engine jits (FedAvg/FedProx
+  weighting rules included);
+- broadcast/collect fans out on a thread per device, so the round time is
+  max(device time), not the sum.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from colearn_federated_learning_tpu.comm.broker import BrokerClient
+from colearn_federated_learning_tpu.comm.enrollment import (
+    DeviceInfo,
+    EnrollmentManager,
+)
+from colearn_federated_learning_tpu.comm.transport import TensorClient
+from colearn_federated_learning_tpu.fed import setup as setup_lib
+from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu.utils import pytrees
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+class FederatedCoordinator:
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        broker_host: str,
+        broker_port: int,
+        round_timeout: float = 60.0,
+        want_evaluator: bool = True,
+    ):
+        self.config = config
+        self.round_timeout = round_timeout
+        self.want_evaluator = want_evaluator
+        self._broker = BrokerClient(broker_host, broker_port)
+        self._enroll = EnrollmentManager(self._broker)
+        params = setup_lib.init_global_params(config)
+        self.server_state = strategies.init_server_state(params, config.fed)
+        self.history: list[dict] = []
+        self._clients: dict[str, TensorClient] = {}
+        self.trainers: list[DeviceInfo] = []
+        self.evaluator: Optional[DeviceInfo] = None
+
+    # ------------------------------------------------------------------
+    def enroll(self, min_devices: int, timeout: float = 30.0) -> None:
+        """Wait for devices, assign roles, open tensor connections."""
+        self._enroll.wait_for(min_devices, timeout)
+        self.trainers, self.evaluator = self._enroll.assign_roles(
+            want_evaluator=self.want_evaluator
+        )
+        for d in self.trainers + ([self.evaluator] if self.evaluator else []):
+            self._clients[d.device_id] = TensorClient(d.host, d.port)
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        self._broker.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    def _reconnect(self, dev: DeviceInfo) -> None:
+        """Replace a device's connection after a timeout: its late reply
+        would otherwise desynchronise the request/reply stream."""
+        self._clients[dev.device_id].close()
+        try:
+            self._clients[dev.device_id] = TensorClient(dev.host, dev.port)
+        except OSError:
+            pass                                      # dead peer: keep closed
+
+    def _sample_cohort(self, round_idx: int) -> list[DeviceInfo]:
+        k = self.config.fed.cohort_size
+        if not k or k >= len(self.trainers):
+            return list(self.trainers)
+        rng = np.random.default_rng(self.config.run.seed * 100_003 + round_idx)
+        idx = rng.choice(len(self.trainers), size=k, replace=False)
+        return [self.trainers[i] for i in sorted(idx)]
+
+    def run_round(self) -> dict:
+        """One federated round: broadcast → parallel local training with a
+        deadline → weighted aggregation of the updates that made it."""
+        r = len(self.history)
+        cohort = self._sample_cohort(r)
+        params_np = jax.tree.map(np.asarray, self.server_state.params)
+        t0 = time.perf_counter()
+
+        def ask(dev: DeviceInfo):
+            header, delta = self._clients[dev.device_id].request(
+                {"op": "train", "round": r}, params_np,
+                meta={"round": r}, timeout=self.round_timeout,
+            )
+            if header.get("status") != "ok":
+                raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
+            return header["meta"], delta
+
+        results, dropped = [], []
+        with cf.ThreadPoolExecutor(max_workers=max(1, len(cohort))) as pool:
+            futs = {pool.submit(ask, d): d for d in cohort}
+            for fut, dev in futs.items():
+                try:
+                    results.append(fut.result(timeout=self.round_timeout))
+                except Exception:                     # timeout / dead peer
+                    dropped.append(dev.device_id)
+                    self._reconnect(dev)
+
+        wsum, total_w, loss_sum, folded = None, 0.0, 0.0, 0
+        for meta, delta in results:
+            if int(meta.get("round", r)) != r:       # stale update: refuse
+                dropped.append(str(meta.get("client_id")))
+                continue
+            w = float(meta.get("weight", 1.0))
+            contrib = pytrees.tree_scale(jax.tree.map(np.asarray, delta), w)
+            wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)
+            total_w += w
+            loss_sum += float(meta.get("mean_loss", 0.0)) * w
+            folded += 1
+
+        if total_w > 0:
+            mean_delta = pytrees.tree_scale(wsum, 1.0 / total_w)
+            self.server_state = strategies.server_update(
+                self.server_state, mean_delta, self.config.fed
+            )
+        rec = {
+            "round": r,
+            "completed": folded,
+            "cohort": len(cohort),
+            "dropped": dropped,
+            "train_loss": loss_sum / total_w if total_w else float("nan"),
+            "total_weight": total_w,
+            "round_time_s": time.perf_counter() - t0,
+        }
+        self.history.append(rec)
+        return rec
+
+    def evaluate(self) -> dict:
+        """Score the global model on the evaluator device (SURVEY.md §3d)."""
+        if self.evaluator is None:
+            raise RuntimeError("no evaluator was assigned")
+        params_np = jax.tree.map(np.asarray, self.server_state.params)
+        header, _ = self._clients[self.evaluator.device_id].request(
+            {"op": "eval"}, params_np, timeout=self.round_timeout
+        )
+        if header.get("status") != "ok":
+            raise RuntimeError(f"evaluator failed: {header.get('error')}")
+        return header["meta"]
+
+    def fit(self, rounds: Optional[int] = None, log_fn=None,
+            eval_every: Optional[int] = None) -> list[dict]:
+        rounds = rounds if rounds is not None else self.config.fed.rounds
+        eval_every = eval_every or self.config.run.eval_every
+        for _ in range(rounds):
+            rec = self.run_round()
+            if self.evaluator is not None and (
+                rec["round"] % max(1, eval_every) == 0
+                or rec["round"] == rounds - 1
+            ):
+                rec.update(self.evaluate())
+            if log_fn is not None:
+                log_fn(rec)
+        return self.history

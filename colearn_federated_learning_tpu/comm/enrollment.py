@@ -1,0 +1,137 @@
+"""Device enrollment and trainer/evaluator role selection.
+
+Mirrors the reference's MQTT negotiation (SURVEY.md §1 "Enrollment /
+discovery": devices announce identity + readiness on topics; the
+coordinator subscribes, assigns **trainer** / **evaluator** roles) on the
+in-tree broker:
+
+  device  --pub-->  colearn/enroll/{device_id}  {device_id, host, port,
+                                                 num_examples, dataset}
+  coord   --pub-->  colearn/role/{device_id}    {role: trainer|evaluator,
+                                                 retain: true}
+
+Both sides publish RETAINED per-device topics, so ordering never races:
+a coordinator that subscribes after devices announced replays their
+enrollments, and a device that subscribes after selection replays its
+role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from colearn_federated_learning_tpu.comm.broker import BrokerClient
+
+ENROLL_TOPIC = "colearn/enroll/"      # + device_id (retained)
+ROLE_TOPIC = "colearn/role/"          # + device_id (retained)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    device_id: str
+    host: str
+    port: int                         # tensor-plane server (transport.py)
+    num_examples: int = 0
+    dataset: str = ""
+
+    def to_fields(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def announce(client: BrokerClient, info: DeviceInfo) -> None:
+    """Device side: publish readiness (reference: publish on MQTT topic)."""
+    client.publish(ENROLL_TOPIC + info.device_id, info.to_fields(),
+                   retain=True)
+
+
+def await_role(client: BrokerClient, device_id: str,
+               timeout: Optional[float] = None) -> str:
+    """Device side: block until the coordinator assigns this device a role.
+    Subscribe BEFORE announcing to avoid a race; retained messages cover
+    the reverse order too."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        remaining = None if deadline is None else deadline - time.monotonic()
+        if remaining is not None and remaining <= 0:
+            raise TimeoutError(f"no role assigned to {device_id}")
+        header, _ = client.recv(timeout=remaining)
+        if header.get("topic") == ROLE_TOPIC + device_id:
+            return header["role"]
+
+
+class EnrollmentManager:
+    """Coordinator side: collect announcements, select roles.
+
+    Selection policy (reference behavior reconstructed from SURVEY.md §2
+    "trainer/evaluator selection"): the LAST enrollee — in announcement
+    order — becomes the evaluator when ``want_evaluator`` and at least two
+    devices enrolled; everyone else trains.
+    """
+
+    def __init__(self, client: BrokerClient):
+        self._client = client
+        self._client.subscribe(ENROLL_TOPIC + "#")
+        self._lock = threading.Lock()
+        self._devices: dict[str, DeviceInfo] = {}
+        self._order: list[str] = []
+
+    def poll(self, duration: float) -> None:
+        """Drain announcements for ``duration`` seconds."""
+        deadline = time.monotonic() + duration
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            try:
+                header, _ = self._client.recv(timeout=remaining)
+            except (TimeoutError, OSError):
+                return
+            if not str(header.get("topic", "")).startswith(ENROLL_TOPIC):
+                continue
+            info = DeviceInfo(
+                device_id=str(header["device_id"]),
+                host=str(header["host"]),
+                port=int(header["port"]),
+                num_examples=int(header.get("num_examples", 0)),
+                dataset=str(header.get("dataset", "")),
+            )
+            with self._lock:
+                if info.device_id not in self._devices:
+                    self._order.append(info.device_id)
+                self._devices[info.device_id] = info
+
+    def wait_for(self, n: int, timeout: float, poll_step: float = 0.2) -> None:
+        """Poll until at least ``n`` devices enrolled (or raise)."""
+        deadline = time.monotonic() + timeout
+        while len(self.devices()) < n:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(self.devices())}/{n} devices enrolled"
+                )
+            self.poll(poll_step)
+
+    def devices(self) -> list[DeviceInfo]:
+        with self._lock:
+            return [self._devices[d] for d in self._order]
+
+    def assign_roles(self, want_evaluator: bool = True
+                     ) -> tuple[list[DeviceInfo], Optional[DeviceInfo]]:
+        """Pick (trainers, evaluator) and publish retained role messages."""
+        devs = self.devices()
+        if not devs:
+            raise RuntimeError("no devices enrolled")
+        evaluator = None
+        trainers = devs
+        if want_evaluator and len(devs) >= 2:
+            evaluator = devs[-1]
+            trainers = devs[:-1]
+        for d in trainers:
+            self._client.publish(ROLE_TOPIC + d.device_id,
+                                 {"role": "trainer"}, retain=True)
+        if evaluator is not None:
+            self._client.publish(ROLE_TOPIC + evaluator.device_id,
+                                 {"role": "evaluator"}, retain=True)
+        return trainers, evaluator

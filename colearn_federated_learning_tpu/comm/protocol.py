@@ -1,0 +1,61 @@
+"""Wire framing: [4B header-len][JSON header][8B body-len][body bytes].
+
+One frame carries a JSON control header (msg type, topic, round index, …)
+plus an optional opaque body (serialized model pytree — see
+utils/serialization.py).  Used by both the pub/sub broker (control plane)
+and the tensor transport (data plane); the reference's equivalent split is
+MQTT JSON payloads + pickled-PySyft-tensor websocket frames.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Optional
+
+_HDR = struct.Struct(">I")     # header length
+_BODY = struct.Struct(">Q")    # body length
+MAX_HEADER = 1 << 20           # 1 MiB of JSON is already absurd
+MAX_BODY = 1 << 34             # 16 GiB
+
+
+class ConnectionClosed(Exception):
+    """Peer closed the socket mid-frame (or before one started)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionClosed(f"peer closed after {len(buf)}/{n} bytes")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    if len(hdr) > MAX_HEADER:
+        raise ValueError(f"header too large: {len(hdr)}")
+    sock.sendall(_HDR.pack(len(hdr)) + hdr + _BODY.pack(len(body)))
+    if body:
+        sock.sendall(body)
+
+
+def recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
+    (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if hlen > MAX_HEADER:
+        raise ValueError(f"corrupt frame: header length {hlen}")
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    (blen,) = _BODY.unpack(_recv_exact(sock, _BODY.size))
+    if blen > MAX_BODY:
+        raise ValueError(f"corrupt frame: body length {blen}")
+    body = _recv_exact(sock, blen) if blen else b""
+    return header, body
+
+
+def connect(host: str, port: int, timeout: Optional[float] = None) -> socket.socket:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock

@@ -1,0 +1,119 @@
+"""Tensor plane: request/reply pytree transport between coordinator and a
+device (the PySyft ``WebsocketServerWorker`` equivalent, SURVEY.md §1
+"Client runtime" / §3b).
+
+A device hosts a ``TensorServer`` whose handler maps
+``(header, pytree) -> (header, pytree)``; the coordinator's
+``TensorClient`` does one round trip per request.  Payloads are
+utils/serialization.py npz bytes — the same format the offline file flow
+writes, so wire and file federation are interchangeable.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Optional
+
+from colearn_federated_learning_tpu.comm import protocol
+from colearn_federated_learning_tpu.utils.serialization import (
+    bytes_to_pytree,
+    pytree_to_bytes,
+)
+
+Handler = Callable[[dict, Any], tuple[dict, Any]]
+
+
+class TensorServer:
+    """Serve ``handler`` on a TCP port (``port=0`` → ephemeral, see
+    ``.port``).  One thread per connection; connections may issue many
+    requests (the coordinator keeps one open across rounds)."""
+
+    def __init__(self, handler: Handler, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._handler = handler
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.host, self.port = self._srv.getsockname()
+        self._stopping = threading.Event()
+
+    def start(self) -> "TensorServer":
+        threading.Thread(target=self._accept_loop, name="tensor-accept",
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,),
+                             name="tensor-conn", daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                header, body = protocol.recv_msg(conn)
+                tree, meta = bytes_to_pytree(body) if body else (None, {})
+                header.setdefault("meta", meta)
+                try:
+                    out_header, out_tree = self._handler(header, tree)
+                except Exception as e:  # report, keep serving
+                    out_header, out_tree = {"status": "error",
+                                            "error": repr(e)}, None
+                out_body = (
+                    pytree_to_bytes(out_tree, out_header.pop("meta", None))
+                    if out_tree is not None else b""
+                )
+                out_header.setdefault("status", "ok")
+                protocol.send_msg(conn, out_header, out_body)
+        except (protocol.ConnectionClosed, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class TensorClient:
+    """Coordinator-side connection to one device's TensorServer."""
+
+    def __init__(self, host: str, port: int, timeout: Optional[float] = None):
+        self._sock = protocol.connect(host, port, timeout=timeout)
+
+    def request(self, header: dict, tree: Any = None,
+                meta: Optional[dict] = None,
+                timeout: Optional[float] = None) -> tuple[dict, Any]:
+        """One round trip.  Raises ``TimeoutError``/``OSError`` on a dead or
+        too-slow peer — the coordinator treats that as a straggler drop."""
+        self._sock.settimeout(timeout)
+        body = pytree_to_bytes(tree, meta) if tree is not None else b""
+        protocol.send_msg(self._sock, header, body)
+        out_header, out_body = protocol.recv_msg(self._sock)
+        out_tree, out_meta = bytes_to_pytree(out_body) if out_body else (None, {})
+        out_header.setdefault("meta", out_meta)
+        return out_header, out_tree
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,183 @@
+"""Device worker: one federated participant as a network service.
+
+The reference's client runtime is a PySyft ``WebsocketServerWorker`` that
+hosts a data shard, receives the global model, runs local PyTorch epochs
+and returns weights (SURVEY.md §3b/§3c).  Here the worker hosts its
+partition slice and a jit-compiled ``lax.scan`` local trainer
+(fed/local.py via fed/setup.py — the SAME trainer the on-device simulation
+vmaps), serves ``train`` / ``eval`` requests over the tensor plane, and
+enrolls itself on the control plane.
+
+Requests:
+  {"op": "train", "round": r} + global params  →  delta + meta{weight,...}
+  {"op": "eval"}              + global params  →  meta{eval_loss, eval_acc}
+  {"op": "info"}                               →  meta{num_examples, ...}
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu.comm.broker import BrokerClient
+from colearn_federated_learning_tpu.comm import enrollment
+from colearn_federated_learning_tpu.comm.transport import TensorServer
+from colearn_federated_learning_tpu.data import registry as data_registry
+from colearn_federated_learning_tpu.data.sharding import pack_client_shards
+from colearn_federated_learning_tpu.fed import setup as setup_lib
+from colearn_federated_learning_tpu.models import registry as model_registry
+from colearn_federated_learning_tpu.utils import prng
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+class DeviceWorker:
+    """One device process/thread: local shard + trainer + tensor server."""
+
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        client_id: int,
+        broker_host: Optional[str] = None,
+        broker_port: Optional[int] = None,
+        dataset: Optional[data_registry.Dataset] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.config = config
+        self.client_id = int(client_id)
+        c = config
+
+        ds = dataset or data_registry.get_dataset(c.data.dataset,
+                                                  seed=c.run.seed)
+        self._dataset = ds
+        labels = np.asarray(ds.y_train)
+        parts = setup_lib.partition_for_config(c, labels)
+        if not 0 <= self.client_id < len(parts):
+            raise ValueError(
+                f"client_id {self.client_id} out of range [0, {len(parts)})"
+            )
+        shard = pack_client_shards(
+            np.asarray(ds.x_train), labels, [parts[self.client_id]],
+            capacity=c.data.max_examples_per_client,
+        )
+        self._x = jnp.asarray(shard.x[0])
+        self._y = jnp.asarray(shard.y[0])
+        self._count = jnp.asarray(shard.counts[0])
+        self.num_examples = int(shard.counts[0])
+
+        model = model_registry.build_model(setup_lib.local_model_config(c.model))
+        local_update, self._num_steps = setup_lib.local_trainer_for_config(
+            c, model.apply, shard.capacity
+        )
+        self._update_fn = jax.jit(local_update)
+        self._model = model
+        self._eval_fn = None          # built on first eval request
+        self._key = prng.experiment_key(c.run.seed)
+
+        self._server = TensorServer(self._handle, host=host, port=port)
+        self._broker: Optional[BrokerClient] = None
+        self._broker_addr = (broker_host, broker_port)
+        self.role: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def host(self) -> str:
+        return self._server.host
+
+    def start(self) -> "DeviceWorker":
+        """Start serving; if a broker address was given, enroll there."""
+        self._server.start()
+        bh, bp = self._broker_addr
+        if bh is not None:
+            self._broker = BrokerClient(bh, bp)
+            # Subscribe to our role topic BEFORE announcing (no race).
+            self._broker.subscribe(
+                enrollment.ROLE_TOPIC + str(self.client_id)
+            )
+            enrollment.announce(self._broker, enrollment.DeviceInfo(
+                device_id=str(self.client_id),
+                host=self.host, port=self.port,
+                num_examples=self.num_examples,
+                dataset=self.config.data.dataset,
+            ))
+        return self
+
+    def await_role(self, timeout: float = 30.0) -> str:
+        if self._broker is None:
+            raise RuntimeError("worker was started without a broker")
+        self.role = enrollment.await_role(
+            self._broker, str(self.client_id), timeout=timeout
+        )
+        return self.role
+
+    def stop(self) -> None:
+        self._server.stop()
+        if self._broker is not None:
+            self._broker.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------------
+    def _handle(self, header: dict, tree: Any) -> tuple[dict, Any]:
+        op = header.get("op")
+        if op == "train":
+            return self._train(int(header.get("round", 0)), tree)
+        if op == "eval":
+            return self._eval(tree)
+        if op == "info":
+            return ({"meta": {"client_id": self.client_id,
+                              "num_examples": self.num_examples,
+                              "num_steps": self._num_steps}}, None)
+        return ({"status": "error", "error": f"unknown op {op!r}"}, None)
+
+    def _train(self, round_idx: int, global_params: Any) -> tuple[dict, Any]:
+        params = jax.tree.map(jnp.asarray, global_params)
+        result = self._update_fn(
+            params, self._x, self._y, self._count,
+            prng.client_round_key(self._key, self.client_id, round_idx),
+            jnp.asarray(self._num_steps, jnp.int32),
+        )
+        delta, weight = setup_lib.finalize_client_delta(
+            self.config, result, self.client_id, round_idx
+        )
+        meta = {"round": round_idx, "weight": weight,
+                "client_id": self.client_id,
+                "num_examples": int(result.num_examples),
+                "mean_loss": float(result.mean_loss)}
+        return ({"meta": meta}, jax.tree.map(np.asarray, delta))
+
+    def _eval(self, global_params: Any) -> tuple[dict, Any]:
+        if self._eval_fn is None:
+            from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
+
+            self._eval_fn = make_eval_fn(
+                self._model.apply, self._dataset.x_test, self._dataset.y_test,
+                batch=max(self.config.fed.batch_size, 64),
+            )
+        params = jax.tree.map(jnp.asarray, global_params)
+        loss, acc = self._eval_fn(params)
+        return ({"meta": {"eval_loss": float(loss),
+                          "eval_acc": float(acc)}}, None)
+
+
+def run_worker_forever(config: ExperimentConfig, client_id: int,
+                       broker_host: str, broker_port: int) -> None:
+    """CLI entry: serve until the process is killed."""
+    worker = DeviceWorker(config, client_id, broker_host, broker_port).start()
+    try:
+        worker.await_role(timeout=3600.0)
+        threading.Event().wait()      # serve forever
+    finally:
+        worker.stop()

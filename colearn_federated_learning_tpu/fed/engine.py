@@ -180,18 +180,15 @@ class FederatedLearner:
         # Under SP the trained module runs on sequence SHARDS inside
         # shard_map; its dense-attention twin (identical param pytree) is
         # used for init and full-sequence evaluation outside the mesh.
-        import dataclasses
-
-        train_model_cfg = c.model
-        if c.model.attn_impl == "ring" and not self.sp:
-            # Single-device run of an SP config: same params, dense core.
-            train_model_cfg = dataclasses.replace(c.model, attn_impl="dense")
+        train_model_cfg = (
+            c.model if self.sp else setup_lib.local_model_config(c.model)
+        )
         self.model = model_registry.build_model(
             train_model_cfg, seq_axis_name=self.seq_axis if self.sp else None
         )
         if self.sp:
             self.eval_model = model_registry.build_model(
-                dataclasses.replace(c.model, attn_impl="dense")
+                setup_lib.local_model_config(c.model)
             )
         else:
             self.eval_model = self.model

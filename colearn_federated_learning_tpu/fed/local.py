@@ -74,18 +74,17 @@ def make_local_update(
 
     def loss_fn(params, global_params, xb, yb):
         logits = apply_fn({"params": params}, xb, train=True)
-        ce = losses.softmax_cross_entropy(logits, yb)
-        loss = ce
+        loss = losses.softmax_cross_entropy(logits, yb)
         if prox_mu > 0.0:
-            # FedProx: + μ/2 ‖w − w_global‖² (BASELINE config #3, μ=0.01)
-            # FedProx grads flow through the (replicated) params on every
-            # shard; under the pmean convention that is already exact.
+            # FedProx: + μ/2 ‖w − w_global‖² (BASELINE config #3, μ=0.01).
+            # Under SP its grads flow through the (replicated) params on
+            # every shard; the pmean convention keeps that exact.
             loss = loss + 0.5 * prox_mu * pytrees.tree_sq_norm(
                 pytrees.tree_sub(params, global_params)
             )
-        return loss, ce
+        return loss
 
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    grad_fn = jax.value_and_grad(loss_fn)
 
     def local_update(global_params, x, y, count, key, step_budget):
         opt_state = optimizer.init(global_params)
@@ -97,7 +96,7 @@ def make_local_update(
             idx = jax.random.randint(k, (batch_size,), 0, safe_count)
             xb = jnp.take(x, idx, axis=0)
             yb = jnp.take(y, idx, axis=0)
-            (_, loss), grads = grad_fn(params, global_params, xb, yb)
+            loss, grads = grad_fn(params, global_params, xb, yb)
             for ax in grad_sync_axes:
                 grads = jax.tree.map(lambda g: jax.lax.pmean(g, ax), grads)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)

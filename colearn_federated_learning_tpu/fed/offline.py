@@ -23,7 +23,6 @@ from colearn_federated_learning_tpu.data.sharding import pack_client_shards
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu.models import registry as model_registry
-from colearn_federated_learning_tpu.privacy import dp as dp_lib
 from colearn_federated_learning_tpu.utils import prng, pytrees
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 from colearn_federated_learning_tpu.utils.serialization import (
@@ -34,14 +33,7 @@ from colearn_federated_learning_tpu.utils.serialization import (
 
 def init_global_model(config: ExperimentConfig, path: str) -> None:
     """Initialize global params from the experiment seed and write them."""
-    ds = data_registry.get_dataset(config.data.dataset, seed=config.run.seed,
-                                   max_train=4 * config.fed.batch_size,
-                                   max_test=1)
-    model = model_registry.build_model(config.model)
-    x = jnp.asarray(ds.x_train[: config.fed.batch_size])
-    params = model_registry.init_params(
-        model, x, prng.init_key(prng.experiment_key(config.run.seed))
-    )
+    params = setup_lib.init_global_params(config)
     save_pytree_npz(path, jax.tree.map(np.asarray, params),
                     meta={"round": 0, "config": config.run.name})
 
@@ -70,7 +62,9 @@ def client_update(
                                 capacity=c.data.max_examples_per_client)
 
     local_update, num_steps = setup_lib.local_trainer_for_config(
-        c, model_registry.build_model(c.model).apply, shards.capacity
+        c,
+        model_registry.build_model(setup_lib.local_model_config(c.model)).apply,
+        shards.capacity,
     )
     update_fn = jax.jit(local_update)
     key = prng.experiment_key(c.run.seed)
@@ -82,15 +76,8 @@ def client_update(
         prng.client_round_key(key, client_id, round_idx),
         jnp.asarray(num_steps, jnp.int32),
     )
-    delta = result.delta
-    weight = float(result.num_examples)
-    if c.fed.dp_clip > 0.0:
-        delta = dp_lib.clip_and_noise(
-            delta, c.fed.dp_clip, c.fed.dp_noise_multiplier,
-            max(c.fed.cohort_size or c.data.num_clients, 1),
-            prng.dp_key(key, client_id, round_idx),
-        )
-        weight = 1.0  # uniform weighting under DP, as in the engine
+    delta, weight = setup_lib.finalize_client_delta(c, result, client_id,
+                                                    round_idx)
 
     save_pytree_npz(out_path, jax.tree.map(np.asarray, delta),
                     meta={"round": round_idx, "weight": weight,
@@ -154,7 +141,9 @@ def evaluate_global(config: ExperimentConfig, global_path: str,
     params, meta = load_pytree_npz(global_path)
     ds = dataset or data_registry.get_dataset(config.data.dataset,
                                               seed=config.run.seed)
-    model = model_registry.build_model(config.model)
+    model = model_registry.build_model(
+        setup_lib.local_model_config(config.model)
+    )
     eval_fn = make_eval_fn(model.apply, ds.x_test, ds.y_test,
                            batch=max(config.fed.batch_size, 64))
     loss, acc = eval_fn(jax.tree.map(jnp.asarray, params))

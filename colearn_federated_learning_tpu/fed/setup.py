@@ -10,13 +10,25 @@ helpers instead of re-deriving the pieces.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable
 
 import numpy as np
 
 from colearn_federated_learning_tpu.data import partition as partition_lib
 from colearn_federated_learning_tpu.fed import local as local_lib
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+def local_model_config(model_cfg):
+    """Model config as seen by a SINGLE process (no mesh): ring attention
+    needs a shard_map sequence axis, so SP configs fall back to the dense
+    core — the param pytree is identical across cores, so checkpoints and
+    wire payloads stay compatible (models/attention.py)."""
+    import dataclasses
+
+    if model_cfg.attn_impl == "ring":
+        return dataclasses.replace(model_cfg, attn_impl="dense")
+    return model_cfg
 
 
 def partition_for_config(
@@ -65,3 +77,47 @@ def local_trainer_for_config(
         grad_sync_axes=grad_sync_axes,
     )
     return update_fn, num_steps
+
+
+def init_global_params(config: ExperimentConfig) -> Any:
+    """Seed-deterministic global model init (shared by the file-based and
+    socket-based federation entrypoints, so every participant derives the
+    IDENTICAL starting point from the config alone)."""
+    import jax.numpy as jnp
+
+    from colearn_federated_learning_tpu.data import registry as data_registry
+    from colearn_federated_learning_tpu.models import registry as model_registry
+    from colearn_federated_learning_tpu.utils import prng
+
+    ds = data_registry.get_dataset(config.data.dataset, seed=config.run.seed,
+                                   max_train=4 * config.fed.batch_size,
+                                   max_test=1)
+    model = model_registry.build_model(local_model_config(config.model))
+    x = jnp.asarray(ds.x_train[: config.fed.batch_size])
+    return model_registry.init_params(
+        model, x, prng.init_key(prng.experiment_key(config.run.seed))
+    )
+
+
+def finalize_client_delta(
+    config: ExperimentConfig, result, client_id: int, round_idx: int
+) -> tuple[Any, float]:
+    """Apply the config's on-update privacy hooks to one client's
+    ``LocalResult`` and return ``(delta, aggregation_weight)`` — identical
+    across the on-device engine's conventions: DP clipping+noise switches
+    FedAvg to uniform weighting."""
+    from colearn_federated_learning_tpu.privacy import dp as dp_lib
+    from colearn_federated_learning_tpu.utils import prng
+
+    delta = result.delta
+    weight = float(result.num_examples)
+    c = config.fed
+    if c.dp_clip > 0.0:
+        key = prng.experiment_key(config.run.seed)
+        delta = dp_lib.clip_and_noise(
+            delta, c.dp_clip, c.dp_noise_multiplier,
+            max(c.cohort_size or config.data.num_clients, 1),
+            prng.dp_key(key, client_id, round_idx),
+        )
+        weight = 1.0
+    return delta, weight

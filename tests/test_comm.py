@@ -1,0 +1,204 @@
+"""comm/: broker pub/sub, enrollment roles, tensor transport, and a full
+socket-federated run (coordinator + in-process DeviceWorkers) — including
+straggler drop and parity with the on-device engine's round math."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.comm.broker import BrokerClient, MessageBroker
+from colearn_federated_learning_tpu.comm.coordinator import FederatedCoordinator
+from colearn_federated_learning_tpu.comm.transport import TensorClient, TensorServer
+from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def _config(num_clients=4, **fed_kw):
+    fed = dict(strategy="fedavg", rounds=2, cohort_size=0, local_steps=3,
+               batch_size=16, lr=0.1, momentum=0.9)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=num_clients,
+                        partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="comm_test", backend="cpu"),
+    )
+
+
+# ---------------------------------------------------------------- broker ----
+def test_broker_pubsub_and_retain():
+    with MessageBroker() as broker:
+        sub = BrokerClient(broker.host, broker.port)
+        sub.subscribe("a/b")
+        pub = BrokerClient(broker.host, broker.port)
+        pub.publish("a/b", {"x": 1}, body=b"payload")
+        header, body = sub.recv(timeout=5.0)
+        assert header["topic"] == "a/b" and header["x"] == 1
+        assert body == b"payload"
+
+        # retained message reaches a LATE subscriber; wildcard matches
+        pub.publish("roles/7", {"role": "trainer"}, retain=True)
+        late = BrokerClient(broker.host, broker.port)
+        late.subscribe("roles/#")
+        header, _ = late.recv(timeout=5.0)
+        assert header["topic"] == "roles/7" and header["role"] == "trainer"
+        sub.close(); pub.close(); late.close()
+
+
+# ------------------------------------------------------------- transport ----
+def test_tensor_transport_roundtrip():
+    def handler(header, tree):
+        assert header["op"] == "double"
+        out = {k: v * 2 for k, v in tree.items()}
+        return {"meta": {"ok": True}}, out
+
+    with TensorServer(handler) as srv:
+        cli = TensorClient(srv.host, srv.port)
+        tree = {"w": np.arange(6.0).reshape(2, 3), "b": np.ones(3)}
+        header, out = cli.request({"op": "double"}, tree, timeout=5.0)
+        assert header["status"] == "ok" and header["meta"]["ok"]
+        np.testing.assert_array_equal(out["w"], tree["w"] * 2)
+        cli.close()
+
+
+def test_tensor_server_reports_handler_errors():
+    def handler(header, tree):
+        raise RuntimeError("boom")
+
+    with TensorServer(handler) as srv:
+        cli = TensorClient(srv.host, srv.port)
+        header, out = cli.request({"op": "x"}, None, timeout=5.0)
+        assert header["status"] == "error" and "boom" in header["error"]
+        cli.close()
+
+
+# ------------------------------------------------------ full federation ----
+def test_socket_federation_end_to_end():
+    cfg = _config(num_clients=4)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(4)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0)
+            coord.enroll(min_devices=4, timeout=20.0)
+            assert len(coord.trainers) == 3 and coord.evaluator is not None
+            roles = [w.await_role(timeout=10.0) for w in workers]
+            assert roles.count("evaluator") == 1 and roles.count("trainer") == 3
+
+            before = coord.evaluate()
+            hist = coord.fit(rounds=3)
+            after = coord.evaluate()
+            assert all(r["completed"] == 3 for r in hist)
+            assert all(not r["dropped"] for r in hist)
+            assert np.isfinite(hist[-1]["train_loss"])
+            assert after["eval_acc"] >= before["eval_acc"]
+            coord.close()
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_socket_federation_drops_straggler():
+    cfg = _config(num_clients=3)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=3, timeout=20.0)
+            warm = coord.run_round()        # jit-compiles every worker
+            assert warm["completed"] == 3
+
+            # Sabotage worker 1's trainer: hang past the round deadline.
+            slow = workers[1]
+            orig = slow._train
+            done = threading.Event()
+
+            def hang(round_idx, params):
+                time.sleep(4.0)
+                done.set()
+                return orig(round_idx, params)
+
+            slow._train = hang
+            coord.round_timeout = 1.5
+            rec = coord.run_round()
+            assert rec["completed"] == 2
+            assert rec["dropped"] == ["1"]
+            assert np.isfinite(rec["train_loss"])
+
+            # After the drop the coordinator reconnected; once the device
+            # recovers it participates again.
+            slow._train = orig
+            done.wait(timeout=10.0)
+            coord.round_timeout = 60.0
+            rec = coord.run_round()
+            assert rec["completed"] == 3 and not rec["dropped"]
+            coord.close()
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_worker_rejects_bad_client_id():
+    with pytest.raises(ValueError, match="out of range"):
+        DeviceWorker(_config(num_clients=2), 5)
+
+
+def test_cli_multiprocess_federation(tmp_path):
+    """The reference's deployment shape — broker + N worker processes +
+    coordinator, each a separate OS process — driven via the colearn CLI."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = ["--config", "mnist_mlp_fedavg", "--dataset", "mnist_tiny",
+            "--num-clients", "3", "--local-steps", "2", "--rounds", "2",
+            "--backend", "cpu"]
+    cli_mod = ["-m", "colearn_federated_learning_tpu.cli"]
+    procs = []
+    try:
+        broker = subprocess.Popen(
+            [sys.executable, *cli_mod, "broker"], env=env,
+            stdout=subprocess.PIPE, text=True,
+        )
+        procs.append(broker)
+        addr = json.loads(broker.stdout.readline())
+        port = str(addr["port"])
+        for i in range(3):
+            procs.append(subprocess.Popen(
+                [sys.executable, *cli_mod, "worker", *args,
+                 "--client-id", str(i), "--broker-port", port],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            ))
+        out = subprocess.run(
+            [sys.executable, *cli_mod, "coordinate", *args,
+             "--broker-port", port, "--min-devices", "3",
+             "--enroll-timeout", "120", "--round-timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        last = json.loads(out.stdout.strip().splitlines()[-1])
+        assert last["round"] == 1 and last["completed"] == 2
+        assert "eval_acc" in last and 0.0 <= last["eval_acc"] <= 1.0
+    finally:
+        for p in procs:
+            p.kill()
