@@ -1,0 +1,79 @@
+"""ctypes loader + numpy-compatible wrappers for the native library.
+
+``gather_rows(src, indices)`` is the public entry: a thread-parallel
+``src[indices]`` for 2-D row-major arrays, used by data/sharding.py to pack
+client shards.  Everything degrades to numpy when the library can't be
+built (no toolchain) or is disabled via ``COLEARN_NO_NATIVE=1``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+ABI_VERSION = 1
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("COLEARN_NO_NATIVE"):
+            return None
+        try:
+            from colearn_federated_learning_tpu.native import build as build_mod
+
+            if build_mod.needs_build():
+                build_mod.build()
+            lib = ctypes.CDLL(str(build_mod.LIB))
+            lib.cl_abi_version.restype = ctypes.c_int
+            if lib.cl_abi_version() != ABI_VERSION:
+                build_mod.build()           # stale cache: rebuild once
+                lib = ctypes.CDLL(str(build_mod.LIB))
+            lib.cl_gather_rows.restype = ctypes.c_int
+            lib.cl_gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int32,
+            ]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def gather_rows(src: np.ndarray, indices: np.ndarray,
+                n_threads: int = 0) -> np.ndarray:
+    """``src[indices]`` over the leading axis, thread-parallel when the
+    native library is present; plain numpy take otherwise.  ``src`` may be
+    any-dimensional; rows are its trailing dims."""
+    lib = load()
+    if lib is None:
+        return np.take(src, indices, axis=0)
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    out = np.empty((idx.shape[0],) + src.shape[1:], dtype=src.dtype)
+    row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.itemsize
+    if row_bytes == 0 or idx.size == 0:
+        return out
+    if n_threads <= 0:
+        n_threads = min(16, os.cpu_count() or 1)
+    rc = lib.cl_gather_rows(
+        src.ctypes.data, src.shape[0], row_bytes,
+        idx.ctypes.data, idx.shape[0],
+        out.ctypes.data, n_threads,
+    )
+    if rc != 0:
+        raise IndexError("gather_rows: index out of range")
+    return out

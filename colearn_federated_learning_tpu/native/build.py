@@ -1,0 +1,40 @@
+"""Build the native library with the system toolchain, cached by mtime.
+
+``python -m colearn_federated_learning_tpu.native.build`` forces a build;
+normally ``native.load()`` triggers it lazily on first use and callers fall
+back to numpy when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+
+_ROOT = pathlib.Path(__file__).resolve().parent
+SOURCES = [_ROOT / "src" / "gather.cpp"]
+LIB = _ROOT / "_build" / "libcolearn_native.so"
+
+
+def needs_build() -> bool:
+    if not LIB.exists():
+        return True
+    lib_mtime = LIB.stat().st_mtime
+    return any(s.stat().st_mtime > lib_mtime for s in SOURCES)
+
+
+def build(verbose: bool = False) -> pathlib.Path:
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found")
+    LIB.parent.mkdir(parents=True, exist_ok=True)
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           *map(str, SOURCES), "-o", str(LIB)]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return LIB
+
+
+if __name__ == "__main__":
+    print(build(verbose=True))

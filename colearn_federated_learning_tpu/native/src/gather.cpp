@@ -1,0 +1,62 @@
+// Native host-side data plane for the federated runtime.
+//
+// The reference's runtime is pure Python (SURVEY.md §2: "Native components:
+// NONE expected") — the rebuild still ships this small C++ layer because the
+// cross-silo configs (3400-client FEMNIST, BASELINE config #5) gather
+// multi-GB client shard tensors on the host before device placement, and
+// numpy's fancy-index gather is single-threaded.  cl_gather_rows is a
+// thread-parallel row gather: dst[i] = src[indices[i]] for row_bytes-sized
+// rows.  Loaded via ctypes (native/__init__.py) with a numpy fallback when
+// the toolchain is absent.
+//
+// Build: native/build.py (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Returns 0 on success, -1 on a bad index (bounds-checked up front so no
+// partial writes from bad input).
+int cl_gather_rows(const uint8_t* src, int64_t n_src_rows, int64_t row_bytes,
+                   const int64_t* indices, int64_t n_out_rows,
+                   uint8_t* dst, int32_t n_threads) {
+  for (int64_t i = 0; i < n_out_rows; ++i) {
+    if (indices[i] < 0 || indices[i] >= n_src_rows) return -1;
+  }
+  if (n_threads < 1) n_threads = 1;
+  int64_t hw = static_cast<int64_t>(std::thread::hardware_concurrency());
+  n_threads = static_cast<int32_t>(
+      std::min<int64_t>(n_threads, std::max<int64_t>(1, hw)));
+  // Small jobs: threading overhead dominates, run inline.
+  if (n_out_rows * row_bytes < (int64_t)1 << 22 || n_threads == 1) {
+    for (int64_t i = 0; i < n_out_rows; ++i) {
+      std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+    return 0;
+  }
+  std::vector<std::thread> workers;
+  int64_t chunk = (n_out_rows + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(lo + chunk, n_out_rows);
+    if (lo >= hi) break;
+    workers.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + i * row_bytes, src + indices[i] * row_bytes,
+                    static_cast<size_t>(row_bytes));
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  return 0;
+}
+
+// Version marker so a stale cached .so is detected and rebuilt.
+int cl_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,22 +1,34 @@
-"""Flat-file pytree serialization (npz) for the cross-silo file/wire plane.
+"""Pytree serialization for the cross-silo file/wire planes.
 
 The reference moves model state between processes as pickled PySyft tensors
-over websockets (SURVEY.md §1 "Communication").  The rebuild's exchange
-format is a plain ``.npz``: each leaf stored under its ``/``-joined tree
-path, plus ``__meta__`` JSON for scalars (weights, round index).  It is
-mmap-friendly, language-neutral, and the same payload is used by the offline
-``colearn aggregate`` flow and the TCP federation transport (comm/).
+over websockets (SURVEY.md §1 "Communication").  The rebuild uses two
+self-describing formats with one decoder:
+
+- FILES (``colearn init/train --role client/aggregate``): plain ``.npz`` —
+  each leaf under its ``/``-joined tree path plus ``__meta__`` JSON.
+  mmap-friendly, loadable by anything that reads npz.
+- WIRE (comm/transport.py): ``CLW1`` flat frames — JSON leaf directory +
+  concatenated raw buffers + crc32.  No zip container overhead, single
+  contiguous payload, integrity-checked.
+
+``bytes_to_pytree`` auto-detects the format, so a silo can hand a wire
+payload to the file flow (or vice versa) without caring which produced it.
 """
 
 from __future__ import annotations
 
 import io
 import json
+import struct
+import zlib
 from typing import Any
 
 import numpy as np
 
 _META = "__meta__"
+_WIRE_MAGIC = b"CLW1"
+_WIRE_HLEN = struct.Struct(">I")
+_WIRE_PAY = struct.Struct(">QI")      # payload length, crc32
 
 
 def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
@@ -65,11 +77,70 @@ def load_pytree_npz(path_or_file) -> tuple[Any, dict]:
     return _unflatten(flat), meta
 
 
-def pytree_to_bytes(tree: Any, meta: dict | None = None) -> bytes:
-    buf = io.BytesIO()
-    save_pytree_npz(buf, tree, meta)
-    return buf.getvalue()
+def pytree_to_bytes(tree: Any, meta: dict | None = None) -> bytearray:
+    """Encode as a ``CLW1`` wire frame (the transport's format)."""
+    flat = {p: np.ascontiguousarray(a) for p, a in _flatten(tree).items()}
+    entries = [{"p": p, "d": a.dtype.str, "s": list(a.shape)}
+               for p, a in flat.items()]
+    header = json.dumps({"leaves": entries, "meta": meta or {}},
+                        separators=(",", ":")).encode()
+    plen = sum(a.nbytes for a in flat.values())
+    # Single allocation, single copy: frame assembled in place, each leaf
+    # copied straight into its payload slot.
+    out = bytearray(len(_WIRE_MAGIC) + _WIRE_HLEN.size + len(header)
+                    + _WIRE_PAY.size + plen)
+    off = 0
+    out[off:off + len(_WIRE_MAGIC)] = _WIRE_MAGIC
+    off += len(_WIRE_MAGIC)
+    _WIRE_HLEN.pack_into(out, off, len(header))
+    off += _WIRE_HLEN.size
+    out[off:off + len(header)] = header
+    off += len(header)
+    pay_hdr_off = off
+    off += _WIRE_PAY.size
+    pay_start = off
+    for a in flat.values():
+        n = a.nbytes
+        if n:
+            np.frombuffer(out, dtype=a.dtype, count=a.size, offset=off)[
+                :
+            ] = a.reshape(-1)
+        off += n
+    crc = zlib.crc32(memoryview(out)[pay_start:])
+    _WIRE_PAY.pack_into(out, pay_hdr_off, plen, crc)
+    return out                        # bytes-like; avoids a full-frame copy
+
+
+def _wire_to_pytree(data: bytes) -> tuple[Any, dict]:
+    off = len(_WIRE_MAGIC)
+    (hlen,) = _WIRE_HLEN.unpack_from(data, off)
+    off += _WIRE_HLEN.size
+    header = json.loads(data[off:off + hlen].decode())
+    off += hlen
+    plen, crc = _WIRE_PAY.unpack_from(data, off)
+    off += _WIRE_PAY.size
+    payload = memoryview(data)[off:off + plen]
+    if zlib.crc32(payload) != crc:
+        raise ValueError("wire payload failed crc32 integrity check")
+    flat: dict[str, np.ndarray] = {}
+    pos = 0
+    for e in header["leaves"]:
+        dtype = np.dtype(e["d"])
+        shape = tuple(e["s"])
+        n = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        # copy() detaches each leaf from the big frame buffer (and makes it
+        # writable); leaves are consumed as independent arrays downstream.
+        flat[e["p"]] = np.frombuffer(
+            payload[pos:pos + n], dtype=dtype
+        ).reshape(shape).copy()
+        pos += n
+    if pos != plen:
+        raise ValueError(f"wire payload size mismatch: {pos} != {plen}")
+    return _unflatten(flat), header.get("meta", {})
 
 
 def bytes_to_pytree(data: bytes) -> tuple[Any, dict]:
+    """Decode either format (CLW1 wire frame or npz), auto-detected."""
+    if data[: len(_WIRE_MAGIC)] == _WIRE_MAGIC:
+        return _wire_to_pytree(data)
     return load_pytree_npz(io.BytesIO(data))
