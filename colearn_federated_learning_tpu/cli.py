@@ -41,7 +41,7 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--momentum", type=float, default=None)
     p.add_argument("--strategy", default=None,
-                   choices=["fedavg", "fedprox", "fedadam", "fedyogi"])
+                   choices=["fedavg", "fedprox", "fedadam", "fedyogi", "scaffold"])
     p.add_argument("--prox-mu", type=float, default=None)
     p.add_argument("--dataset", default=None)
     p.add_argument("--partition", default=None, choices=["iid", "dirichlet"])

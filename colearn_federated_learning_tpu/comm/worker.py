@@ -50,6 +50,7 @@ class DeviceWorker:
         self.config = config
         self.client_id = int(client_id)
         c = config
+        setup_lib.require_stateless_strategy(c, "the socket worker")
 
         ds = dataset or data_registry.get_dataset(c.data.dataset,
                                                   seed=c.run.seed)

@@ -198,10 +198,27 @@ class FederatedLearner:
         self.server_state = strategies.init_server_state(self.params, c.fed)
 
         # --- local trainer -------------------------------------------
+        self.scaffold = c.fed.strategy == "scaffold"
+        if self.scaffold and (c.fed.secure_agg or c.fed.dp_clip > 0.0):
+            raise ValueError(
+                "scaffold is incompatible with secure_agg/dp hooks: the "
+                "control-variate deltas are a second payload the masks and "
+                "noise calibration do not cover"
+            )
         self.local_update, self.num_steps = setup_lib.local_trainer_for_config(
             c, self.model.apply, shards.capacity,
             grad_sync_axes=(self.seq_axis,) if self.sp else (),
         )
+        # SCAFFOLD per-client control variates: one params-shaped pytree per
+        # client, stacked on the client axis (memory = num_clients × model;
+        # intended for the cross-device cohort-sampling regime it targets).
+        if self.scaffold:
+            self.client_c = jax.tree.map(
+                lambda w: jnp.zeros((self.num_clients,) + w.shape, w.dtype),
+                self.params,
+            )
+        else:
+            self.client_c = None
 
         # --- cohort ---------------------------------------------------
         cohort = c.fed.cohort_size or self.num_clients
@@ -259,7 +276,8 @@ class FederatedLearner:
     # one round, single-device (vmap over the cohort)
     # ------------------------------------------------------------------
     def _cohort_step(self, params, local_ids, global_ids, mask_cohort_ids,
-                     x, y, counts, key, round_idx):
+                     x, y, counts, key, round_idx,
+                     control=None, c_blk=None):
         """Shared per-cohort logic: local training + privacy + weighting.
 
         ``local_ids`` index into the (possibly per-device) ``x/y/counts``
@@ -267,9 +285,12 @@ class FederatedLearner:
         PRNG derivation, so results are bit-identical regardless of how
         clients are placed on devices.  ``mask_cohort_ids`` is the FULL
         round cohort (all devices) that secure-agg masks pair against.
-        Returns (weighted_delta_sum, total_weight, metrics) so the caller
-        can finish aggregation either locally (vmap path) or with a psum
-        (shard_map path).
+        ``control`` / ``c_blk`` are the scaffold global variate and this
+        block's stacked per-client variates.
+        Returns (weighted_delta_sum, total_weight, metrics, scaffold_extras)
+        — the caller finishes aggregation either locally (vmap path) or
+        with a psum (shard_map path); ``scaffold_extras`` is None or
+        ``(delta_c_uniform_sum, n_contributors, updated_c_blk)``.
         """
         c = self.config.fed
         cx = jnp.take(x, local_ids, axis=0)
@@ -296,13 +317,23 @@ class FederatedLearner:
         else:
             budgets = jnp.full((self.cohort_size_local,), self.num_steps, jnp.int32)
 
-        results = jax.vmap(self.local_update, in_axes=(None, 0, 0, 0, 0, 0))(
-            params, cx, cy, ccounts, keys, budgets
-        )
+        if self.scaffold:
+            c_i = jax.tree.map(lambda l: jnp.take(l, local_ids, axis=0), c_blk)
+            sres = jax.vmap(
+                self.local_update, in_axes=(None, 0, 0, 0, 0, 0, 0, None)
+            )(params, cx, cy, ccounts, keys, budgets, c_i, control)
+            results = sres.result
+        else:
+            sres = None
+            results = jax.vmap(self.local_update, in_axes=(None, 0, 0, 0, 0, 0))(
+                params, cx, cy, ccounts, keys, budgets
+            )
         deltas = results.delta
         completed = results.completed
 
-        uniform_weights = c.dp_clip > 0.0 or c.secure_agg
+        # SCAFFOLD averages uniformly over the sampled cohort (the variate
+        # algebra assumes it); DP/secure-agg force uniform weights too.
+        uniform_weights = c.dp_clip > 0.0 or c.secure_agg or self.scaffold
         if c.dp_clip > 0.0:
             dp_keys = jax.vmap(lambda i: prng.dp_key(key, i, round_idx))(global_ids)
             deltas = jax.vmap(
@@ -334,10 +365,29 @@ class FederatedLearner:
         loss_sum = jnp.sum(results.mean_loss * weights)
         # "completed" reports real contributors only (ghost padding slots
         # always finish their budget but never contribute).
-        n_completed = jnp.sum((completed & nonghost).astype(jnp.int32))
-        return wsum, total_w, (loss_sum, n_completed)
+        contrib = completed & nonghost
+        n_completed = jnp.sum(contrib.astype(jnp.int32))
 
-    def _finish_round(self, server_state, wsum, total_w, loss_sum, n_comp):
+        extras = None
+        if self.scaffold:
+            uw = contrib.astype(jnp.float32)
+            dc_sum = pytrees.tree_weighted_sum(sres.delta_c, uw)
+            # Refresh only contributors' variates; scatter back into the
+            # stacked block.
+            c_masked = jax.tree.map(
+                lambda new, old: jnp.where(
+                    contrib.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
+                ),
+                sres.c_new, c_i,
+            )
+            new_c_blk = jax.tree.map(
+                lambda full, upd: full.at[local_ids].set(upd), c_blk, c_masked
+            )
+            extras = (dc_sum, n_completed.astype(jnp.float32), new_c_blk)
+        return wsum, total_w, (loss_sum, n_completed), extras
+
+    def _finish_round(self, server_state, wsum, total_w, loss_sum, n_comp,
+                      dc_sum=None, n_contrib=None):
         """Shared round epilogue (vmap and shard_map paths): mean delta,
         server update, metrics.  Zero contributors (all stragglers) → no-op
         update; the explicit gate matters under secure_agg, where wsum is
@@ -346,8 +396,17 @@ class FederatedLearner:
         mean_delta = pytrees.tree_scale(
             wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
         )
+        mean_delta_c = participation = None
+        if self.scaffold:
+            safe_n = jnp.maximum(n_contrib, 1.0)
+            mean_delta_c = pytrees.tree_scale(
+                dc_sum, jnp.where(n_contrib > 0, 1.0 / safe_n, 0.0)
+            )
+            participation = n_contrib / float(self.real_num_clients)
         new_state = strategies.server_update(server_state, mean_delta,
-                                             self.config.fed)
+                                             self.config.fed,
+                                             mean_delta_c=mean_delta_c,
+                                             participation=participation)
         metrics = {
             "train_loss": loss_sum / denom,
             "completed": n_comp,
@@ -363,7 +422,8 @@ class FederatedLearner:
             self.cohort_size_local = self.cohort_size
 
             @jax.jit
-            def round_fn(server_state, key, round_idx, x, y, counts, ids):
+            def round_fn(server_state, key, round_idx, x, y, counts, ids,
+                         client_c):
                 skey = prng.sampling_key(key, round_idx)
                 if self.cohort_size < self.num_clients:
                     # Uniform sample WITHOUT replacement among real clients:
@@ -375,12 +435,19 @@ class FederatedLearner:
                 else:
                     sel = jnp.arange(self.num_clients)
                 cohort_global = jnp.take(ids, sel)
-                wsum, total_w, (loss_sum, n_comp) = self._cohort_step(
+                wsum, total_w, (loss_sum, n_comp), extras = self._cohort_step(
                     server_state.params, sel, cohort_global, cohort_global,
-                    x, y, counts, key, round_idx
+                    x, y, counts, key, round_idx,
+                    control=server_state.control, c_blk=client_c,
                 )
-                return self._finish_round(server_state, wsum, total_w,
-                                          loss_sum, n_comp)
+                dc_sum, n_contrib, new_c = (
+                    extras if extras is not None else (None, None, client_c)
+                )
+                new_state, metrics = self._finish_round(
+                    server_state, wsum, total_w, loss_sum, n_comp,
+                    dc_sum=dc_sum, n_contrib=n_contrib,
+                )
+                return new_state, metrics, new_c
 
             return round_fn
 
@@ -392,7 +459,8 @@ class FederatedLearner:
         self.cohort_size_local = self.cohort_per_device
         local_clients = self.num_clients // self.clients_size
 
-        def body(server_state, key, round_idx, x_blk, y_blk, counts_blk, ids_blk):
+        def body(server_state, key, round_idx, x_blk, y_blk, counts_blk,
+                 ids_blk, c_blk):
             dev = jax.lax.axis_index(ax)
             skey = jax.random.fold_in(prng.sampling_key(key, round_idx), dev)
             if self.cohort_per_device < local_clients:
@@ -408,24 +476,35 @@ class FederatedLearner:
             # Secure-agg masks pair against the FULL mesh-wide cohort: a
             # cheap all_gather of the (cohort_per_device,) id vectors.
             mask_cohort = jax.lax.all_gather(cohort_global, ax).reshape(-1)
-            wsum, total_w, (loss_sum, n_comp) = self._cohort_step(
+            wsum, total_w, (loss_sum, n_comp), extras = self._cohort_step(
                 server_state.params, sel, cohort_global, mask_cohort,
-                x_blk, y_blk, counts_blk, key, round_idx
+                x_blk, y_blk, counts_blk, key, round_idx,
+                control=server_state.control, c_blk=c_blk,
             )
             # FedAvg across the pod: one psum over ICI per leaf.
             wsum = jax.tree.map(lambda l: jax.lax.psum(l, ax), wsum)
             total_w = jax.lax.psum(total_w, ax)
             loss_sum = jax.lax.psum(loss_sum, ax)
             n_comp = jax.lax.psum(n_comp, ax)
-            return self._finish_round(server_state, wsum, total_w,
-                                      loss_sum, n_comp)
+            if extras is not None:
+                dc_sum, n_contrib, new_c = extras
+                dc_sum = jax.tree.map(lambda l: jax.lax.psum(l, ax), dc_sum)
+                n_contrib = jax.lax.psum(n_contrib, ax)
+            else:
+                dc_sum, n_contrib, new_c = None, None, c_blk
+            new_state, metrics = self._finish_round(
+                server_state, wsum, total_w, loss_sum, n_comp,
+                dc_sum=dc_sum, n_contrib=n_contrib,
+            )
+            return new_state, metrics, new_c
 
         x_spec = P(ax, None, self.seq_axis) if self.sp else P(ax)
+        c_spec = P(ax) if self.scaffold else P()
         sharded = shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(), P(), x_spec, P(ax), P(ax), P(ax)),
-            out_specs=(P(), P()),
+            in_specs=(P(), P(), P(), x_spec, P(ax), P(ax), P(ax), c_spec),
+            out_specs=(P(), P(), c_spec),
             check_vma=False,
         )
         return jax.jit(sharded)
@@ -446,11 +525,12 @@ class FederatedLearner:
     # ------------------------------------------------------------------
     def run_round(self) -> dict:
         r = len(self.history)
-        self.server_state, metrics = self._round_fn(
+        self.server_state, metrics, self.client_c = self._round_fn(
             self.server_state,
             self.base_key,
             jnp.asarray(r, jnp.int32),
             *self._device_data,
+            self.client_c,
         )
         out = {k: float(v) for k, v in metrics.items()}
         out["round"] = r
@@ -472,12 +552,18 @@ class FederatedLearner:
         return self._ckpt
 
     def save_checkpoint(self) -> None:
-        self._checkpointer().save(len(self.history), self.server_state, self.history)
+        # Scaffold's per-client variates are part of the training state and
+        # checkpoint alongside the server state (None otherwise).
+        self._checkpointer().save(
+            len(self.history), (self.server_state, self.client_c), self.history
+        )
 
     def restore_checkpoint(self) -> int:
         """Restore the latest checkpoint; returns the resumed round index."""
-        state, history, step = self._checkpointer().restore(self.server_state)
-        self.server_state = state
+        state, history, step = self._checkpointer().restore(
+            (self.server_state, self.client_c)
+        )
+        self.server_state, self.client_c = state
         self.history = history
         return step
 

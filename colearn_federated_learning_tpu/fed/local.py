@@ -35,6 +35,12 @@ class LocalResult(NamedTuple):
     mean_loss: jnp.ndarray     # () float32 over executed steps
 
 
+class ScaffoldResult(NamedTuple):
+    result: LocalResult
+    c_new: Any               # this client's updated control variate
+    delta_c: Any             # c_new - c_old (server control update)
+
+
 def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
 
@@ -56,8 +62,17 @@ def make_local_update(
     prox_mu: float = 0.0,
     min_steps_fraction: float = 0.25,
     grad_sync_axes: tuple[str, ...] = (),
+    scaffold: bool = False,
+    lr: float = 0.0,
 ) -> Callable:
     """Build ``local_update(global_params, x, y, count, key, step_budget)``.
+
+    With ``scaffold=True`` the signature gains trailing ``(c_i, c)``
+    control-variate pytrees and the return becomes a ``ScaffoldResult``
+    (SCAFFOLD, Karimireddy et al. 2019: per-step grads are corrected by
+    ``- c_i + c``, and the client's variate refreshes via option II,
+    ``c_i' = c_i - c + (w_global - w_local)/(K·lr)`` over the K executed
+    steps).  ``lr`` must then be the client learning rate.
 
     - ``x``: (M, ...) padded shard, ``y``: (M,), ``count``: () true size.
     - ``num_steps`` is the static per-round step budget (epochs * ceil(M/B)).
@@ -86,7 +101,10 @@ def make_local_update(
 
     grad_fn = jax.value_and_grad(loss_fn)
 
-    def local_update(global_params, x, y, count, key, step_budget):
+    if scaffold and lr <= 0.0:
+        raise ValueError("scaffold=True requires the client lr")
+
+    def run_steps(global_params, x, y, count, key, step_budget, correction):
         opt_state = optimizer.init(global_params)
         safe_count = jnp.maximum(count, 1)
 
@@ -99,6 +117,8 @@ def make_local_update(
             loss, grads = grad_fn(params, global_params, xb, yb)
             for ax in grad_sync_axes:
                 grads = jax.tree.map(lambda g: jax.lax.pmean(g, ax), grads)
+            if correction is not None:
+                grads = pytrees.tree_add(grads, correction)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             active = t < step_budget
@@ -111,11 +131,36 @@ def make_local_update(
         )
         executed = jnp.minimum(step_budget, num_steps).astype(jnp.float32)
         mean_loss = jnp.sum(step_losses) / jnp.maximum(executed, 1.0)
-        return LocalResult(
+        result = LocalResult(
             delta=pytrees.tree_sub(params, global_params),
             num_examples=count.astype(jnp.int32),
             completed=step_budget >= min_steps,
             mean_loss=mean_loss,
         )
+        return result, executed
 
-    return local_update
+    if not scaffold:
+        def local_update(global_params, x, y, count, key, step_budget):
+            result, _ = run_steps(global_params, x, y, count, key,
+                                  step_budget, None)
+            return result
+
+        return local_update
+
+    def scaffold_update(global_params, x, y, count, key, step_budget, c_i, c):
+        correction = pytrees.tree_sub(c, c_i)     # grads - c_i + c
+        result, executed = run_steps(global_params, x, y, count, key,
+                                     step_budget, correction)
+        # Option II refresh: c_i' = c_i - c + (w_g - w_local)/(K·lr).
+        scale = 1.0 / (jnp.maximum(executed, 1.0) * lr)
+        c_new = pytrees.tree_add(
+            pytrees.tree_sub(c_i, c),
+            pytrees.tree_scale(result.delta, -scale),
+        )
+        return ScaffoldResult(
+            result=result,
+            c_new=c_new,
+            delta_c=pytrees.tree_sub(c_new, c_i),
+        )
+
+    return scaffold_update

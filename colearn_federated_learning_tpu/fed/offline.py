@@ -49,6 +49,7 @@ def client_update(
     """One silo's local round: load global params, train on the silo's
     partition, write a weighted delta update file.  Returns summary stats."""
     c = config
+    setup_lib.require_stateless_strategy(c, "the file-based client flow")
     params, meta = load_pytree_npz(global_path)
     round_idx = int(meta.get("round", round_idx))
 

@@ -75,8 +75,21 @@ def local_trainer_for_config(
         prox_mu=c.prox_mu if c.strategy == "fedprox" else 0.0,
         min_steps_fraction=c.straggler_min_fraction,
         grad_sync_axes=grad_sync_axes,
+        scaffold=c.strategy == "scaffold",
+        lr=c.lr,
     )
     return update_fn, num_steps
+
+
+def require_stateless_strategy(config: ExperimentConfig, where: str) -> None:
+    """File/socket participants keep no cross-round client state, so the
+    stateful SCAFFOLD strategy only runs in the on-device engine."""
+    if config.fed.strategy == "scaffold":
+        raise NotImplementedError(
+            f"{where} does not support 'scaffold' (per-client control "
+            "variates are engine-resident); use the on-device simulation "
+            "or a stateless strategy"
+        )
 
 
 def init_global_params(config: ExperimentConfig) -> Any:

@@ -10,6 +10,10 @@ psum across the mesh) and applies a server optimizer:
   client loss, fed/local.py)
 - fedadam / fedyogi: adaptive server optimizers (Reddi et al., "Adaptive
   Federated Optimization" — capability superset of the reference)
+- scaffold        : control-variate correction (Karimireddy et al.) — the
+  server additionally maintains the global variate c, updated by the
+  participation-weighted mean of client variate deltas; the per-client
+  variates live in the engine (stacked over the client mesh axis)
 
 All states are pytrees; the whole update jits and shards with the params.
 """
@@ -29,6 +33,7 @@ class ServerState(NamedTuple):
     params: Any
     opt_m: Optional[Any]      # first moment (fedadam/fedyogi) or None
     opt_v: Optional[Any]      # second moment or None
+    control: Optional[Any]    # global control variate c (scaffold) or None
     round_idx: jnp.ndarray    # () int32
 
 
@@ -39,17 +44,37 @@ def init_server_state(params, cfg: FedConfig) -> ServerState:
         params=params,
         opt_m=zeros if adaptive else None,
         opt_v=zeros if adaptive else None,
+        control=zeros if cfg.strategy == "scaffold" else None,
         round_idx=jnp.zeros((), jnp.int32),
     )
 
 
-def server_update(state: ServerState, mean_delta, cfg: FedConfig) -> ServerState:
-    if cfg.strategy in ("fedavg", "fedprox"):
+def server_update(
+    state: ServerState,
+    mean_delta,
+    cfg: FedConfig,
+    mean_delta_c=None,
+    participation: Optional[jnp.ndarray] = None,
+) -> ServerState:
+    """Apply one server step to the aggregated mean delta.
+
+    ``mean_delta_c`` / ``participation`` (|S|/N) are scaffold-only: the
+    global variate moves by ``participation · mean_delta_c``.
+    """
+    if cfg.strategy in ("fedavg", "fedprox", "scaffold"):
         new_params = jax.tree.map(
             lambda w, d: w + cfg.server_lr * d.astype(w.dtype),
             state.params, mean_delta,
         )
-        return ServerState(new_params, None, None, state.round_idx + 1)
+        control = state.control
+        if cfg.strategy == "scaffold" and mean_delta_c is not None:
+            frac = 1.0 if participation is None else participation
+            control = jax.tree.map(
+                lambda c, dc: c + frac * dc.astype(c.dtype),
+                control, mean_delta_c,
+            )
+        return ServerState(new_params, None, None, control,
+                           state.round_idx + 1)
 
     if cfg.strategy in ("fedadam", "fedyogi"):
         b1, b2, eps = cfg.server_beta1, cfg.server_beta2, cfg.server_eps
@@ -67,6 +92,6 @@ def server_update(state: ServerState, mean_delta, cfg: FedConfig) -> ServerState
             lambda w, m_, v_: w + (cfg.server_lr * m_ / (jnp.sqrt(v_) + eps)).astype(w.dtype),
             state.params, m, v,
         )
-        return ServerState(new_params, m, v, state.round_idx + 1)
+        return ServerState(new_params, m, v, None, state.round_idx + 1)
 
     raise ValueError(f"unknown strategy {cfg.strategy!r}")

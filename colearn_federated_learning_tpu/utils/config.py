@@ -40,7 +40,7 @@ class ModelConfig:
 
 @dataclasses.dataclass(frozen=True)
 class FedConfig:
-    strategy: str = "fedavg"          # "fedavg" | "fedprox" | "fedadam" | "fedyogi"
+    strategy: str = "fedavg"          # fedavg | fedprox | fedadam | fedyogi | scaffold
     rounds: int = 20
     cohort_size: int = 0              # clients sampled per round; 0 = all
     local_epochs: int = 1
