@@ -55,6 +55,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--log-file", default=None)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of rounds 1-2 here")
 
 
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
@@ -62,7 +64,7 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "dp_clip", "dp_noise_multiplier", "secure_agg", "straggler_prob"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
-             "checkpoint_every"}
+             "checkpoint_every", "profile_dir"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:

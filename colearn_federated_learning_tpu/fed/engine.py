@@ -414,6 +414,14 @@ class FederatedLearner:
         }
         return new_state, metrics
 
+    def _donate_argnums(self) -> tuple[int, ...]:
+        """Donate the consumed round state (server_state, client_c) so XLA
+        reuses their HBM in place — matters for big models and the stacked
+        scaffold variates.  CPU ignores donation with a warning, so skip."""
+        devs = self.mesh.devices.flat if self.mesh is not None else jax.devices()
+        first = next(iter(devs))
+        return () if first.platform == "cpu" else (0, 7)
+
     def _build_round_fn(self):
         c = self.config.fed
         ax = self.config.run.mesh_axis
@@ -421,7 +429,6 @@ class FederatedLearner:
         if self.mesh is None:
             self.cohort_size_local = self.cohort_size
 
-            @jax.jit
             def round_fn(server_state, key, round_idx, x, y, counts, ids,
                          client_c):
                 skey = prng.sampling_key(key, round_idx)
@@ -449,7 +456,7 @@ class FederatedLearner:
                 )
                 return new_state, metrics, new_c
 
-            return round_fn
+            return jax.jit(round_fn, donate_argnums=self._donate_argnums())
 
         # ---- multi-chip: shard_map over the client axis (and, under SP,
         # the sequence axis — every collective below names ONLY the client
@@ -507,7 +514,7 @@ class FederatedLearner:
             out_specs=(P(), P(), c_spec),
             check_vma=False,
         )
-        return jax.jit(sharded)
+        return jax.jit(sharded, donate_argnums=self._donate_argnums())
 
     # ------------------------------------------------------------------
     # evaluation (held-out global test set, SURVEY.md §3d)
@@ -580,9 +587,19 @@ class FederatedLearner:
         ckpt_every = max(0, run.checkpoint_every)
         want_ckpt = bool(run.checkpoint_dir)
         last_round = len(self.history) + rounds - 1  # fit() may be called again
+        from colearn_federated_learning_tpu.utils.profiling import RoundProfiler
+
+        profiler = RoundProfiler(run.profile_dir)
         for _ in range(rounds):
             t0 = time.perf_counter()
+            profiler.before_round(len(self.history))
             rec = self.run_round()
+            if profiler._active:
+                # The trace window must contain the round's device work —
+                # only synchronise while actually tracing (blocking every
+                # round would serialise the async dispatch pipeline).
+                jax.block_until_ready(self.server_state.params)
+            profiler.after_round(rec["round"])
             rec["round_time_s"] = time.perf_counter() - t0
             if rec["round"] % eval_every == 0 or rec["round"] == last_round:
                 loss, acc = self.evaluate()

@@ -75,6 +75,7 @@ class RunConfig:
     eval_every: int = 1
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0         # 0 disables
+    profile_dir: Optional[str] = None  # jax.profiler trace output (rounds 1-2)
 
 
 @dataclasses.dataclass(frozen=True)

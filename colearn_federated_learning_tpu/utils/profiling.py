@@ -1,0 +1,40 @@
+"""Tracing/profiling (SURVEY.md §5: the reference has none; the rebuild
+exposes ``jax.profiler`` traces viewable in TensorBoard via
+tensorboard-plugin-profile or Perfetto).
+
+``RoundProfiler`` traces a bounded window of federated rounds — by default
+rounds 1..2, skipping round 0 so compile time doesn't drown the steady
+state — writing to ``RunConfig.profile_dir`` (CLI ``--profile-dir``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+class RoundProfiler:
+    """Start/stop a jax profiler trace around a window of rounds."""
+
+    def __init__(self, profile_dir: Optional[str], first_round: int = 1,
+                 num_rounds: int = 2):
+        self.profile_dir = profile_dir
+        self.first = first_round
+        self.last = first_round + num_rounds - 1
+        self._active = False
+
+    def before_round(self, round_idx: int) -> None:
+        if self.profile_dir and not self._active and round_idx == self.first:
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+
+    def after_round(self, round_idx: int) -> None:
+        if self._active and round_idx >= self.last:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
