@@ -40,6 +40,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--local-optimizer", default=None,
+                   choices=["sgd", "adam", "adamw"])
     p.add_argument("--strategy", default=None,
                    choices=["fedavg", "fedprox", "fedadam", "fedyogi", "scaffold"])
     p.add_argument("--prox-mu", type=float, default=None)
@@ -60,8 +62,9 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
 
 
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
-             "batch_size", "lr", "momentum", "strategy", "prox_mu",
-             "dp_clip", "dp_noise_multiplier", "secure_agg", "straggler_prob"}
+             "batch_size", "lr", "momentum", "local_optimizer", "strategy",
+             "prox_mu", "dp_clip", "dp_noise_multiplier", "secure_agg",
+             "straggler_prob"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}
@@ -125,6 +128,12 @@ def cmd_train(args: argparse.Namespace) -> int:
             print(json.dumps(rec), file=sys.stderr)
 
         learner.fit(log_fn=log_fn)
+        if args.per_client_eval:
+            rep = learner.evaluate_per_client()
+            print(json.dumps({
+                k: (v.tolist() if hasattr(v, "tolist") else v)
+                for k, v in rep.items()
+            }), file=sys.stderr)
         samples = (learner.cohort_size * learner.num_steps
                    * config.fed.batch_size)
         n_chips = learner.mesh.devices.size if learner.mesh is not None else 1
@@ -237,6 +246,8 @@ def main(argv: list[str] | None = None) -> int:
     p_train.add_argument("--out", default=None,
                          help="update npz to write (client role)")
     p_train.add_argument("--resume", action="store_true")
+    p_train.add_argument("--per-client-eval", action="store_true",
+                         help="report per-client accuracy spread at the end")
     p_train.set_defaults(fn=cmd_train)
 
     p_init = sub.add_parser("init", help="write an initial global model file")

@@ -548,6 +548,90 @@ class FederatedLearner:
         loss, acc = self._eval_fn(self.server_state.params)
         return float(loss), float(acc)
 
+    # ---- federated (per-client) evaluation ---------------------------
+    def evaluate_per_client(self) -> dict:
+        """Score the CURRENT global model on every client's local shard.
+
+        The reference's evaluator role scores one held-out set (SURVEY.md
+        §3d); this is the federated-native complement — the model's fit to
+        each client's own distribution, the quantity that matters under
+        non-IID partitions.  One jit program, vmapped over clients (and
+        sharded over the client axis on a mesh); returns per-client arrays
+        in ORIGINAL client-id order plus weighted aggregates and the
+        across-client accuracy spread.
+        """
+        if not hasattr(self, "_client_eval_fn"):
+            self._client_eval_fn = self._build_client_eval_fn()
+        loss, acc = self._client_eval_fn(
+            self.server_state.params, *self._device_data[:3]
+        )
+        loss, acc = np.asarray(loss), np.asarray(acc)
+        counts = np.asarray(self.shards.counts)
+        # Undo the mesh interleaving, drop ghost clients.
+        order = np.argsort(self.client_ids, kind="stable")
+        loss, acc, counts = loss[order], acc[order], counts[order]
+        real = counts > 0
+        loss, acc, counts = loss[real], acc[real], counts[real]
+        w = counts / counts.sum()
+        return {
+            "per_client_loss": loss,
+            "per_client_acc": acc,
+            "num_examples": counts,
+            "weighted_loss": float((loss * w).sum()),
+            "weighted_acc": float((acc * w).sum()),
+            "acc_p10": float(np.percentile(acc, 10)),
+            "acc_p50": float(np.percentile(acc, 50)),
+            "acc_p90": float(np.percentile(acc, 90)),
+        }
+
+    def _build_client_eval_fn(self):
+        batch = max(self.config.fed.batch_size, 64)
+        cap = self.shards.capacity
+        n_chunks = int(np.ceil(cap / batch))
+        padded = n_chunks * batch
+        # Under SP the shard data arrives sequence-sharded, so the eval
+        # must run the ring-attention (SP-aware) module, not the dense twin.
+        apply_fn = (self.model if self.sp else self.eval_model).apply
+
+        def one_client(params, cx, cy, count):
+            # Pad the shard to whole chunks; only rows < count score.
+            pad = padded - cap
+            cxp = jnp.concatenate(
+                [cx, jnp.zeros((pad,) + cx.shape[1:], cx.dtype)]
+            ) if pad else cx
+            cyp = jnp.concatenate([cy, jnp.zeros((pad,), cy.dtype)]) if pad else cy
+            xb = cxp.reshape((n_chunks, batch) + cx.shape[1:])
+            yb = cyp.reshape((n_chunks, batch))
+            base = jnp.arange(n_chunks) * batch
+
+            def step(carry, inp):
+                x_, y_, b = inp
+                logits = apply_fn({"params": params}, x_, train=False)
+                ce = jax.nn.log_softmax(logits.astype(jnp.float32))
+                nll = -jnp.take_along_axis(ce, y_[:, None], axis=1)[:, 0]
+                correct = (jnp.argmax(logits, axis=-1) == y_).astype(jnp.float32)
+                m = ((b + jnp.arange(batch)) < count).astype(jnp.float32)
+                l, a, n = carry
+                return (l + jnp.sum(nll * m), a + jnp.sum(correct * m),
+                        n + jnp.sum(m)), None
+
+            (l, a, n), _ = jax.lax.scan(step, (0.0, 0.0, 0.0), (xb, yb, base))
+            n = jnp.maximum(n, 1.0)
+            return l / n, a / n
+
+        vmapped = jax.vmap(one_client, in_axes=(None, 0, 0, 0))
+        if self.mesh is None:
+            return jax.jit(vmapped)
+
+        ax = self.client_axis
+        x_spec = P(ax, None, self.seq_axis) if self.sp else P(ax)
+        return jax.jit(shard_map(
+            vmapped, mesh=self.mesh,
+            in_specs=(P(), x_spec, P(ax), P(ax)),
+            out_specs=(P(ax), P(ax)),
+            check_vma=False,
+        ))
+
     # ---- checkpoint/resume (SURVEY.md §5; ckpt/manager.py) -----------
     def _checkpointer(self):
         if self._ckpt is None:

@@ -45,13 +45,25 @@ def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
-def make_optimizer(lr: float, momentum: float) -> optax.GradientTransformation:
-    """Plain SGD(+momentum) matching torch semantics: buf = m*buf + g;
+def make_optimizer(lr: float, momentum: float,
+                   name: str = "sgd") -> optax.GradientTransformation:
+    """Client-side optimizer.
+
+    ``sgd``: plain SGD(+momentum) matching torch semantics: buf = m*buf + g;
     p -= lr*buf (optax ``trace`` with nesterov=False, SURVEY.md §7 hard
-    part #4 — optimizer parity with the reference's PyTorch SGD)."""
-    if momentum > 0:
-        return optax.sgd(lr, momentum=momentum, nesterov=False)
-    return optax.sgd(lr)
+    part #4 — optimizer parity with the reference's PyTorch SGD).
+    ``adam`` / ``adamw``: adaptive local optimizers (common for the text
+    configs; the reference's workers run whatever torch.optim they choose).
+    """
+    if name == "sgd":
+        if momentum > 0:
+            return optax.sgd(lr, momentum=momentum, nesterov=False)
+        return optax.sgd(lr)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adamw":
+        return optax.adamw(lr)
+    raise ValueError(f"unknown local optimizer {name!r} (sgd|adam|adamw)")
 
 
 def make_local_update(

@@ -65,8 +65,13 @@ def local_trainer_for_config(
 
     ``grad_sync_axes``: sequence-parallel mesh axes (fed/local.py)."""
     c = config.fed
+    if c.strategy == "scaffold" and c.local_optimizer != "sgd":
+        raise ValueError(
+            "scaffold's option-II variate refresh assumes plain SGD steps; "
+            f"local_optimizer={c.local_optimizer!r} is unsupported"
+        )
     num_steps = num_steps_for_config(config, capacity)
-    optimizer = local_lib.make_optimizer(c.lr, c.momentum)
+    optimizer = local_lib.make_optimizer(c.lr, c.momentum, c.local_optimizer)
     update_fn = local_lib.make_local_update(
         apply_fn,
         optimizer,

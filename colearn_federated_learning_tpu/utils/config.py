@@ -48,6 +48,7 @@ class FedConfig:
     batch_size: int = 32
     lr: float = 0.1
     momentum: float = 0.9
+    local_optimizer: str = "sgd"      # sgd | adam | adamw (client-side)
     prox_mu: float = 0.0              # FedProx μ (BASELINE config #3: 0.01)
     server_lr: float = 1.0            # server-side step on the mean delta
     server_beta1: float = 0.9         # FedAdam/FedYogi

@@ -1,0 +1,96 @@
+"""Per-client federated evaluation, local optimizer choice, and
+end-to-end determinism."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def _cfg(**fed_kw):
+    fed = dict(strategy="fedavg", rounds=2, cohort_size=0, local_steps=3,
+               batch_size=16, lr=0.1, momentum=0.9)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=6,
+                        partition="dirichlet", dirichlet_alpha=0.3),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="eval_extras", backend="cpu"),
+    )
+
+
+def test_per_client_eval_shapes_and_aggregates():
+    l = FederatedLearner(_cfg())
+    for _ in range(3):
+        l.run_round()
+    rep = l.evaluate_per_client()
+    n = len(rep["per_client_acc"])
+    assert n == 6
+    assert rep["num_examples"].sum() > 0
+    assert 0.0 <= rep["weighted_acc"] <= 1.0
+    assert rep["acc_p10"] <= rep["acc_p50"] <= rep["acc_p90"]
+    w = rep["num_examples"] / rep["num_examples"].sum()
+    np.testing.assert_allclose(
+        rep["weighted_acc"], float((rep["per_client_acc"] * w).sum()),
+        rtol=1e-6,
+    )
+
+
+def test_per_client_eval_mesh_matches_vmap(cpu_devices):
+    cfg = _cfg()
+    a = FederatedLearner(cfg)
+    b = FederatedLearner(cfg, mesh=Mesh(np.array(cpu_devices[:4]), ("clients",)))
+    a.run_round(); b.run_round()
+    ra = a.evaluate_per_client()
+    rb = b.evaluate_per_client()
+    # Same original-client-id order on both placements.
+    np.testing.assert_array_equal(ra["num_examples"], rb["num_examples"])
+    np.testing.assert_allclose(ra["per_client_acc"], rb["per_client_acc"],
+                               atol=1e-5)
+    np.testing.assert_allclose(ra["per_client_loss"], rb["per_client_loss"],
+                               rtol=1e-4)
+
+
+def test_local_adam_trains():
+    l = FederatedLearner(_cfg(local_optimizer="adam", lr=0.003))
+    first = l.run_round()
+    for _ in range(4):
+        rec = l.run_round()
+    assert rec["train_loss"] < first["train_loss"]
+
+
+def test_local_optimizer_validation():
+    with pytest.raises(ValueError, match="unknown local optimizer"):
+        FederatedLearner(_cfg(local_optimizer="lion"))
+    with pytest.raises(ValueError, match="option-II"):
+        FederatedLearner(_cfg(strategy="scaffold", local_optimizer="adam"))
+
+
+def test_same_seed_is_bitwise_deterministic():
+    cfg = _cfg(straggler_prob=0.3, cohort_size=3)
+    a = FederatedLearner(cfg)
+    b = FederatedLearner(cfg)
+    for _ in range(3):
+        ra = a.run_round()
+        rb = b.run_round()
+        assert ra == rb
+    pa = np.asarray(next(iter(jax_leaves(a))))
+    pb = np.asarray(next(iter(jax_leaves(b))))
+    np.testing.assert_array_equal(pa, pb)
+
+
+def jax_leaves(learner):
+    import jax
+
+    return jax.tree.leaves(learner.server_state.params)
