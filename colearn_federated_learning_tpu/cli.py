@@ -51,10 +51,14 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dp-clip", type=float, default=None)
     p.add_argument("--dp-noise-multiplier", type=float, default=None)
     p.add_argument("--secure-agg", action="store_true", default=None)
+    p.add_argument("--compress", default=None, choices=["none", "int8"],
+                   help="update compression on the wire/file planes")
     p.add_argument("--straggler-prob", type=float, default=None)
     p.add_argument("--eval-every", type=int, default=None)
     p.add_argument("--log-every", type=int, default=None)
     p.add_argument("--log-file", default=None)
+    p.add_argument("--tensorboard-dir", default=None,
+                   help="mirror scalar round metrics to TensorBoard")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--profile-dir", default=None,
@@ -64,7 +68,7 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "batch_size", "lr", "momentum", "local_optimizer", "strategy",
              "prox_mu", "dp_clip", "dp_noise_multiplier", "secure_agg",
-             "straggler_prob"}
+             "straggler_prob", "compress"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}
@@ -118,7 +122,8 @@ def cmd_train(args: argparse.Namespace) -> int:
     from colearn_federated_learning_tpu.metrics import MetricsLogger
 
     learner = FederatedLearner.from_config(config)
-    with MetricsLogger(path=args.log_file, name=config.run.name) as logger:
+    with MetricsLogger(path=args.log_file, name=config.run.name,
+                       tensorboard_dir=args.tensorboard_dir) as logger:
         if args.resume:
             step = learner.restore_checkpoint()
             print(f"resumed at round {step}", file=sys.stderr)
@@ -210,7 +215,8 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
         coord.enroll(min_devices=args.min_devices,
                      timeout=args.enroll_timeout)
         hist = coord.fit(log_fn=lambda rec: print(json.dumps(rec),
-                                                  file=sys.stderr))
+                                                  file=sys.stderr),
+                         elastic=args.elastic)
         print(json.dumps(hist[-1]))
     return 0
 
@@ -294,6 +300,8 @@ def main(argv: list[str] | None = None) -> int:
     p_coord.add_argument("--enroll-timeout", type=float, default=60.0)
     p_coord.add_argument("--round-timeout", type=float, default=120.0)
     p_coord.add_argument("--no-evaluator", action="store_true")
+    p_coord.add_argument("--elastic", action="store_true",
+                         help="admit late-joining workers between rounds")
     p_coord.set_defaults(fn=cmd_coordinate)
 
     p_bench = sub.add_parser("bench", help="run the headline benchmark")

@@ -86,6 +86,14 @@ class MessageBroker:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            # Re-check AFTER accept: some loopback shims deliver one more
+            # connection even though the listener was closed by stop().
+            if self._stopping.is_set():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
                 self._wlocks[conn] = threading.Lock()

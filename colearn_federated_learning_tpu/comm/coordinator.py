@@ -57,6 +57,8 @@ class FederatedCoordinator:
         self._clients: dict[str, TensorClient] = {}
         self.trainers: list[DeviceInfo] = []
         self.evaluator: Optional[DeviceInfo] = None
+        self._fail_counts: dict[str, int] = {}
+        self.evict_after = 3          # consecutive failed rounds → evicted
 
     # ------------------------------------------------------------------
     def enroll(self, min_devices: int, timeout: float = 30.0) -> None:
@@ -80,6 +82,54 @@ class FederatedCoordinator:
         self.close()
 
     # ------------------------------------------------------------------
+    def refresh_membership(self, poll: float = 0.1) -> list[str]:
+        """Elastic membership: admit devices that enrolled AFTER the
+        initial ``enroll()``.  New devices get the trainer role (retained)
+        and join the next round's sampling pool.  The reference has no
+        equivalent — workers present at startup are the federation forever;
+        here the broker's retained enrollments make late joiners cheap."""
+        from colearn_federated_learning_tpu.comm.enrollment import ROLE_TOPIC
+
+        self._enroll.poll(poll)
+        known = {d.device_id for d in self.trainers}
+        if self.evaluator is not None:
+            known.add(self.evaluator.device_id)
+        admitted = []
+        for d in self._enroll.devices():
+            if d.device_id in known:
+                continue
+            try:
+                self._clients[d.device_id] = TensorClient(d.host, d.port)
+            except OSError:
+                continue
+            self._broker.publish(ROLE_TOPIC + d.device_id,
+                                 {"role": "trainer"}, retain=True)
+            self.trainers.append(d)
+            admitted.append(d.device_id)
+        return admitted
+
+    def _note_round_outcome(self, cohort, dropped) -> list[str]:
+        """Track consecutive failures; evict peers dead for
+        ``evict_after`` straight rounds (failure detection, SURVEY.md §5)."""
+        dropped_set = set(dropped)
+        for d in cohort:
+            if d.device_id in dropped_set:
+                self._fail_counts[d.device_id] = (
+                    self._fail_counts.get(d.device_id, 0) + 1
+                )
+            else:
+                self._fail_counts.pop(d.device_id, None)
+        evicted = [i for i, n in self._fail_counts.items()
+                   if n >= self.evict_after]
+        for dev_id in evicted:
+            self._fail_counts.pop(dev_id, None)
+            self.trainers = [t for t in self.trainers
+                             if t.device_id != dev_id]
+            cli = self._clients.pop(dev_id, None)
+            if cli is not None:
+                cli.close()
+        return evicted
+
     def _reconnect(self, dev: DeviceInfo) -> None:
         """Replace a device's connection after a timeout: its late reply
         would otherwise desynchronise the request/reply stream."""
@@ -124,11 +174,14 @@ class FederatedCoordinator:
                     dropped.append(dev.device_id)
                     self._reconnect(dev)
 
+        from colearn_federated_learning_tpu.fed import compression
+
         wsum, total_w, loss_sum, folded = None, 0.0, 0.0, 0
         for meta, delta in results:
             if int(meta.get("round", r)) != r:       # stale update: refuse
                 dropped.append(str(meta.get("client_id")))
                 continue
+            delta = compression.decompress_delta(delta, meta)
             w = float(meta.get("weight", 1.0))
             contrib = pytrees.tree_scale(jax.tree.map(np.asarray, delta), w)
             wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)
@@ -141,11 +194,13 @@ class FederatedCoordinator:
             self.server_state = strategies.server_update(
                 self.server_state, mean_delta, self.config.fed
             )
+        evicted = self._note_round_outcome(cohort, dropped)
         rec = {
             "round": r,
             "completed": folded,
             "cohort": len(cohort),
             "dropped": dropped,
+            "evicted": evicted,
             "train_loss": loss_sum / total_w if total_w else float("nan"),
             "total_weight": total_w,
             "round_time_s": time.perf_counter() - t0,
@@ -166,10 +221,15 @@ class FederatedCoordinator:
         return header["meta"]
 
     def fit(self, rounds: Optional[int] = None, log_fn=None,
-            eval_every: Optional[int] = None) -> list[dict]:
+            eval_every: Optional[int] = None,
+            elastic: bool = False) -> list[dict]:
+        """``elastic=True`` polls enrollment between rounds so late-joining
+        devices are admitted mid-run."""
         rounds = rounds if rounds is not None else self.config.fed.rounds
         eval_every = eval_every or self.config.run.eval_every
         for _ in range(rounds):
+            if elastic:
+                self.refresh_membership()
             rec = self.run_round()
             if self.evaluator is not None and (
                 rec["round"] % max(1, eval_every) == 0

@@ -38,6 +38,8 @@ class TensorServer:
         self._srv.listen(16)
         self.host, self.port = self._srv.getsockname()
         self._stopping = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     def start(self) -> "TensorServer":
         threading.Thread(target=self._accept_loop, name="tensor-accept",
@@ -45,11 +47,26 @@ class TensorServer:
         return self
 
     def stop(self) -> None:
+        """Stop accepting AND sever live connections — a stopped server
+        must actually disappear from the federation, not linger on
+        already-open sockets."""
         self._stopping.set()
         try:
             self._srv.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self.start()
@@ -63,7 +80,17 @@ class TensorServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            # Re-check AFTER accept: some loopback shims deliver one more
+            # connection even though the listener was closed by stop().
+            if self._stopping.is_set():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              name="tensor-conn", daemon=True).start()
 
@@ -87,6 +114,8 @@ class TensorServer:
         except (protocol.ConnectionClosed, OSError, ValueError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:

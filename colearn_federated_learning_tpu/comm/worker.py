@@ -157,7 +157,13 @@ class DeviceWorker:
                 "client_id": self.client_id,
                 "num_examples": int(result.num_examples),
                 "mean_loss": float(result.mean_loss)}
-        return ({"meta": meta}, jax.tree.map(np.asarray, delta))
+        from colearn_federated_learning_tpu.fed import compression
+
+        wire, cmeta = compression.compress_delta(
+            jax.tree.map(np.asarray, delta), self.config.fed.compress
+        )
+        meta.update(cmeta)
+        return ({"meta": meta}, wire)
 
     def _eval(self, global_params: Any) -> tuple[dict, Any]:
         if self._eval_fn is None:

@@ -1,0 +1,69 @@
+"""Update compression for the cross-process planes.
+
+The reference ships full-precision state_dicts over websockets; at the
+edge, update size is the round bottleneck.  The rebuild compresses client
+DELTAS (not params — deltas are small-range and quantize well):
+
+- ``int8``: per-leaf symmetric linear quantization — float32 payloads
+  shrink ~4x, each leaf replaced by ``{"q": int8[...], "s": scale}``.
+  Quantization error per round is O(scale/127); FedAvg's averaging
+  further shrinks it by the cohort size.
+- ``none``: passthrough.
+
+Only the WIRE/FILE planes compress (comm/worker.py replies, offline update
+files).  The on-device engine never needs to — its aggregation is a psum,
+no serialization involved.  Config: ``FedConfig.compress``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+SCHEMES = ("none", "int8")
+_Q, _S = "q", "s"
+
+
+def _is_qleaf(node: Any) -> bool:
+    return isinstance(node, dict) and set(node) == {_Q, _S}
+
+
+def compress_delta(delta: Any, scheme: str) -> tuple[Any, dict]:
+    """Returns (wire_tree, meta_fields) — a nested dict the CLW1/npz
+    codecs serialize directly."""
+    if scheme == "none":
+        return delta, {"compress": "none"}
+    if scheme != "int8":
+        raise ValueError(f"unknown compression {scheme!r} (use {SCHEMES})")
+
+    def q(leaf):
+        arr = np.asarray(leaf, dtype=np.float32)
+        scale = float(np.max(np.abs(arr))) / 127.0 if arr.size else 0.0
+        if scale == 0.0:
+            qa = np.zeros(arr.shape, np.int8)
+        else:
+            qa = np.clip(np.rint(arr / scale), -127, 127).astype(np.int8)
+        return {_Q: qa, _S: np.float32(scale)}
+
+    import jax
+
+    return jax.tree.map(q, delta), {"compress": "int8"}
+
+
+def decompress_delta(wire_tree: Any, meta: dict) -> Any:
+    """Inverse of :func:`compress_delta`; rebuilds the float delta."""
+    scheme = meta.get("compress", "none")
+    if scheme == "none":
+        return wire_tree
+    if scheme != "int8":
+        raise ValueError(f"unknown compression {scheme!r}")
+
+    def walk(node):
+        if _is_qleaf(node):
+            return np.asarray(node[_Q], np.float32) * np.float32(node[_S])
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        raise TypeError(f"unexpected node {type(node).__name__} in int8 tree")
+
+    return walk(wire_tree)

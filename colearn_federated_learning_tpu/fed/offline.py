@@ -80,11 +80,16 @@ def client_update(
     delta, weight = setup_lib.finalize_client_delta(c, result, client_id,
                                                     round_idx)
 
-    save_pytree_npz(out_path, jax.tree.map(np.asarray, delta),
+    from colearn_federated_learning_tpu.fed import compression
+
+    wire, cmeta = compression.compress_delta(
+        jax.tree.map(np.asarray, delta), c.fed.compress
+    )
+    save_pytree_npz(out_path, wire,
                     meta={"round": round_idx, "weight": weight,
                           "client_id": client_id,
                           "num_examples": int(result.num_examples),
-                          "mean_loss": float(result.mean_loss)})
+                          "mean_loss": float(result.mean_loss), **cmeta})
     return {"client_id": client_id, "round": round_idx, "weight": weight,
             "mean_loss": float(result.mean_loss)}
 
@@ -102,6 +107,8 @@ def aggregate_updates(
     params, meta = load_pytree_npz(global_path)
     round_idx = int(meta.get("round", 0))
 
+    from colearn_federated_learning_tpu.fed import compression
+
     wsum = None
     total_w = 0.0
     for p in update_paths:
@@ -113,6 +120,7 @@ def aggregate_updates(
                 f"stale update {p}: computed at round {umeta['round']}, "
                 f"global model is at round {round_idx}"
             )
+        delta = compression.decompress_delta(delta, umeta)
         w = float(umeta.get("weight", 1.0))
         contrib = pytrees.tree_scale(delta, w)
         wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)

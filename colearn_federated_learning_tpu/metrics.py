@@ -1,9 +1,11 @@
-"""Structured metrics: JSONL records + throughput counters.
+"""Structured metrics: JSONL records + throughput counters + TensorBoard.
 
 The reference logs accuracy-per-round with prints/CSV (SURVEY.md §5
 "Metrics/logging").  The rebuild emits structured JSONL — one record per
-federated round — and computes the BASELINE.json headline counters:
-``rounds_per_sec``, ``client_samples_per_sec_per_chip``, and ``acc@round``.
+federated round — computes the BASELINE.json headline counters
+(``rounds_per_sec``, ``client_samples_per_sec_per_chip``, ``acc@round``),
+and optionally mirrors scalar metrics to TensorBoard event files
+(``tensorboard_dir``; lazy import, no-op if the writer is unavailable).
 """
 
 from __future__ import annotations
@@ -22,7 +24,8 @@ class MetricsLogger:
     """
 
     def __init__(self, path: Optional[str] = None, name: str = "default",
-                 stream: Optional[IO] = None):
+                 stream: Optional[IO] = None,
+                 tensorboard_dir: Optional[str] = None):
         self.name = name
         self.path = path
         self._fh: Optional[IO] = stream
@@ -31,6 +34,14 @@ class MetricsLogger:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a", buffering=1)
             self._owns_fh = True
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from flax.metrics import tensorboard as _tb
+
+                self._tb = _tb.SummaryWriter(tensorboard_dir)
+            except Exception:
+                self._tb = None
         self.records: list[dict] = []
         self._t_start = time.perf_counter()
 
@@ -41,6 +52,11 @@ class MetricsLogger:
         self.records.append(rec)
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
+        if self._tb is not None and "round" in rec:
+            step = int(rec["round"])
+            for k, v in rec.items():
+                if isinstance(v, (int, float)) and k not in ("round", "ts"):
+                    self._tb.scalar(k, v, step)
         return rec
 
     def summary(self, samples_per_round: float = 0.0, n_chips: int = 1) -> dict:
@@ -69,6 +85,9 @@ class MetricsLogger:
         if self._fh is not None and self._owns_fh:
             self._fh.close()
             self._fh = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
     def __enter__(self) -> "MetricsLogger":
         return self

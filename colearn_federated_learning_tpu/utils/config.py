@@ -63,6 +63,8 @@ class FedConfig:
     dp_clip: float = 0.0              # 0 disables clipping
     dp_noise_multiplier: float = 0.0  # Gaussian sigma = mult * clip
     secure_agg: bool = False
+    # Update compression on the wire/file planes (fed/compression.py).
+    compress: str = "none"            # none | int8
 
 
 @dataclasses.dataclass(frozen=True)

@@ -202,3 +202,77 @@ def test_cli_multiprocess_federation(tmp_path):
     finally:
         for p in procs:
             p.kill()
+
+
+def test_elastic_admission_and_eviction():
+    cfg = _config(num_clients=4)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(2)
+        ]
+        late = None
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=2, timeout=20.0)
+            assert len(coord.trainers) == 2
+            warm = coord.run_round()
+            assert warm["completed"] == 2
+
+            # A third device joins mid-run; refresh admits it.
+            late = DeviceWorker(cfg, 2, broker.host, broker.port).start()
+            admitted = []
+            for _ in range(50):                     # poll until seen
+                admitted = coord.refresh_membership(poll=0.1)
+                if admitted:
+                    break
+            assert admitted == ["2"]
+            rec = coord.run_round()
+            assert rec["completed"] == 3
+
+            # Kill it permanently: after evict_after consecutive failed
+            # rounds it is removed from the federation.
+            late.stop()
+            coord.round_timeout = 1.5
+            evicted = []
+            for _ in range(coord.evict_after + 1):
+                rec = coord.run_round()
+                evicted += rec["evicted"]
+                if evicted:
+                    break
+            assert evicted == ["2"]
+            assert [t.device_id for t in coord.trainers] == ["0", "1"]
+            coord.round_timeout = 60.0
+            rec = coord.run_round()
+            assert rec["completed"] == 2 and not rec["dropped"]
+            coord.close()
+        finally:
+            for w in workers:
+                w.stop()
+            if late is not None:
+                late.stop()
+
+
+def test_socket_federation_with_int8_compression():
+    import dataclasses
+
+    cfg = _config(num_clients=3)
+    cfg = cfg.replace(fed=dataclasses.replace(cfg.fed, compress="int8"))
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0)
+            coord.enroll(min_devices=3, timeout=20.0)
+            before = coord.evaluate()
+            coord.fit(rounds=3)
+            after = coord.evaluate()
+            assert after["eval_acc"] >= before["eval_acc"]
+        finally:
+            for w in workers:
+                w.stop()
