@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 
@@ -30,8 +31,53 @@ BATCH = 32
 WIDTH = 64
 NUM_CLIENTS = 64
 
+# Fallback workload for a CPU run (backend flake / no accelerator): same
+# program structure, sized so the XLA:CPU compile finishes in seconds —
+# round-1's forced-CPU bench died compiling the width-64 scan.
+CPU_WORKLOAD = dict(cohort=8, local_steps=2, batch=8, width=16,
+                    num_clients=32, examples_per_client=64,
+                    dtype="float32")  # XLA:CPU emulates bf16 ~10x slower
+TPU_WORKLOAD = dict(cohort=COHORT, local_steps=LOCAL_STEPS, batch=BATCH,
+                    width=WIDTH, num_clients=NUM_CLIENTS,
+                    examples_per_client=256, dtype="bfloat16")
 
-def run_tpu_native(rounds: int, warmup: int) -> dict:
+
+def probe_platform(timeout_s: float = 90.0) -> str | None:
+    """Which platform does a fresh ``jax.devices()`` resolve to — answered
+    from a SUBPROCESS so a hung/flaky TPU plugin cannot hang the bench.
+    Returns the platform string, or None if the probe errored or timed out
+    (callers should then force CPU without touching the default backend)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip().splitlines()[-1]
+    except Exception:
+        pass
+    return None
+
+
+def force_cpu() -> None:
+    """Switch this process to the CPU backend WITHOUT initializing (or
+    waiting on) the default one — safe to call after ``import jax``."""
+    import os
+
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()
+    except Exception:
+        pass
+
+
+def run_tpu_native(rounds: int, warmup: int, workload: dict | None = None) -> dict:
     import jax
 
     from colearn_federated_learning_tpu.data import registry as data_registry
@@ -40,24 +86,26 @@ def run_tpu_native(rounds: int, warmup: int) -> dict:
         DataConfig, ExperimentConfig, FedConfig, ModelConfig, RunConfig,
     )
 
+    w = workload or TPU_WORKLOAD
     config = ExperimentConfig(
-        data=DataConfig(dataset="cifar10", num_clients=NUM_CLIENTS,
+        data=DataConfig(dataset="cifar10", num_clients=w["num_clients"],
                         partition="dirichlet", dirichlet_alpha=0.5,
-                        max_examples_per_client=256),
-        model=ModelConfig(name="cnn", num_classes=10, width=WIDTH,
-                          dtype="bfloat16"),
-        fed=FedConfig(strategy="fedavg", cohort_size=COHORT,
-                      local_steps=LOCAL_STEPS, batch_size=BATCH,
+                        max_examples_per_client=w["examples_per_client"]),
+        model=ModelConfig(name="cnn", num_classes=10, width=w["width"],
+                          dtype=w["dtype"]),
+        fed=FedConfig(strategy="fedavg", cohort_size=w["cohort"],
+                      local_steps=w["local_steps"], batch_size=w["batch"],
                       lr=0.05, momentum=0.9),
         run=RunConfig(name="bench", backend="auto"),
     )
-    dataset = data_registry.get_dataset("cifar10", seed=0,
-                                        max_train=NUM_CLIENTS * 256,
-                                        max_test=512)
+    dataset = data_registry.get_dataset(
+        "cifar10", seed=0,
+        max_train=w["num_clients"] * w["examples_per_client"], max_test=512,
+    )
     learner = FederatedLearner.from_config(config, dataset=dataset)
     n_devices = learner.mesh.devices.size if learner.mesh is not None else 1
     # Actual per-round work (cohort may be adjusted to the mesh size).
-    samples_per_round = learner.cohort_size * learner.num_steps * BATCH
+    samples_per_round = learner.cohort_size * learner.num_steps * w["batch"]
 
     for _ in range(warmup):
         learner.run_round()
@@ -78,18 +126,23 @@ def run_tpu_native(rounds: int, warmup: int) -> dict:
     }
 
 
-def run_reference_style(rounds: int) -> dict:
+def run_reference_style(rounds: int, workload: dict | None = None) -> dict:
     """Reference architecture stand-in: sequential per-client torch-CPU SGD +
-    host-side numpy weighted averaging of state_dicts (SURVEY.md §3a/§3c)."""
+    host-side numpy weighted averaging of state_dicts (SURVEY.md §3a/§3c).
+    ``workload`` must match the measured run's (same model width, cohort,
+    steps, batch) for ``vs_baseline`` to be a like-for-like ratio."""
     import numpy as np
     import torch
     import torch.nn as tnn
 
+    w = workload or TPU_WORKLOAD
+    cohort, local_steps = w["cohort"], w["local_steps"]
+    batch, width = w["batch"], w["width"]
     torch.manual_seed(0)
 
     class TorchCNN(tnn.Module):
         # Same op graph as colearn_federated_learning_tpu/models/cnn.py.
-        def __init__(self, width=WIDTH, num_classes=10):
+        def __init__(self, width=width, num_classes=10):
             super().__init__()
             layers, in_ch = [], 3
             for mult in (1, 2, 4):
@@ -111,9 +164,9 @@ def run_reference_style(rounds: int) -> dict:
 
     rng = np.random.default_rng(0)
     data = [
-        (torch.randn(LOCAL_STEPS, BATCH, 3, 32, 32),
-         torch.from_numpy(rng.integers(0, 10, (LOCAL_STEPS, BATCH))).long())
-        for _ in range(COHORT)
+        (torch.randn(local_steps, batch, 3, 32, 32),
+         torch.from_numpy(rng.integers(0, 10, (local_steps, batch))).long())
+        for _ in range(cohort)
     ]
     global_model = TorchCNN()
     global_sd = {k: v.clone() for k, v in global_model.state_dict().items()}
@@ -126,13 +179,13 @@ def run_reference_style(rounds: int) -> dict:
             model = TorchCNN()
             model.load_state_dict(global_sd)  # "broadcast"
             opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
-            for s in range(LOCAL_STEPS):
+            for s in range(local_steps):
                 opt.zero_grad()
                 loss_fn(model(cx[s]), cy[s]).backward()
                 opt.step()
             # "websocket return": state_dict to host numpy
             updates.append({k: v.detach().numpy() for k, v in model.state_dict().items()})
-            weights.append(LOCAL_STEPS * BATCH)
+            weights.append(local_steps * batch)
         # host-side fed_avg(weights, sizes)
         total = float(sum(weights))
         global_sd = {
@@ -147,28 +200,82 @@ def run_reference_style(rounds: int) -> dict:
 
 def main(argv: list[str] | None = None) -> None:
     """``argv=None`` parses ``sys.argv``; pass an explicit list when calling
-    from another CLI (e.g. ``colearn bench`` passes its remaining args)."""
+    from another CLI (e.g. ``colearn bench`` passes its remaining args).
+
+    Robustness contract (the driver records this output unconditionally):
+    the ONE JSON line is always printed, with a ``platform`` field —
+    ``tpu``-class when the accelerator answers a bounded-time probe, ``cpu``
+    with a small fast-compile workload when it doesn't, ``error`` only if
+    even the CPU fallback failed."""
     p = argparse.ArgumentParser(prog="colearn bench")
     p.add_argument("--rounds", type=int, default=20)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--baseline-rounds", type=int, default=1)
     p.add_argument("--skip-baseline", action="store_true")
+    p.add_argument("--probe-timeout", type=float, default=90.0)
+    p.add_argument("--force-cpu", action="store_true")
     args = p.parse_args(argv)
 
-    ours = run_tpu_native(args.rounds, args.warmup)
-    print(f"[bench] tpu-native: {ours}", file=sys.stderr)
+    platform = None if args.force_cpu else probe_platform(args.probe_timeout)
+    if platform is None or platform == "cpu":
+        print(f"[bench] accelerator probe -> {platform!r}; forcing CPU "
+              "fallback workload", file=sys.stderr)
+        force_cpu()
+        attempts = [("cpu", CPU_WORKLOAD)]
+    else:
+        print(f"[bench] accelerator probe -> {platform!r}", file=sys.stderr)
+        attempts = [(platform, TPU_WORKLOAD), ("cpu", CPU_WORKLOAD)]
+
+    ours, used_workload, err = None, None, None
+    for plat, workload in attempts:
+        try:
+            # The sandbox CPU is a single core (~5s/round even on the small
+            # workload); cap the timed rounds so a fallback still finishes
+            # well inside the driver's window.
+            rounds = args.rounds if plat != "cpu" else min(args.rounds, 5)
+            if rounds != args.rounds:
+                print(f"[bench] cpu fallback: capping --rounds "
+                      f"{args.rounds} -> {rounds}", file=sys.stderr)
+            ours = run_tpu_native(rounds, args.warmup, workload)
+            ours["rounds_timed"] = rounds
+            used_workload = workload
+            print(f"[bench] tpu-native: {ours}", file=sys.stderr)
+            break
+        except Exception as e:  # noqa: BLE001 — always fall through to JSON
+            err = f"{type(e).__name__}: {e}"
+            print(f"[bench] {plat} run failed: {err}", file=sys.stderr)
+            if plat != "cpu":
+                force_cpu()
 
     vs = 0.0
-    if not args.skip_baseline:
-        base = run_reference_style(args.baseline_rounds)
-        print(f"[bench] reference-style torch-cpu: {base}", file=sys.stderr)
-        vs = ours["rounds_per_sec"] / base["rounds_per_sec"]
+    if ours is not None and not args.skip_baseline:
+        try:
+            base = run_reference_style(args.baseline_rounds, used_workload)
+            print(f"[bench] reference-style torch-cpu: {base}", file=sys.stderr)
+            vs = ours["rounds_per_sec"] / base["rounds_per_sec"]
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] baseline failed: {e}", file=sys.stderr)
 
+    if ours is None:
+        print(json.dumps({
+            "metric": "fedavg_cifar10_cnn_rounds_per_sec",
+            "value": 0.0,
+            "unit": "rounds/sec",
+            "vs_baseline": 0.0,
+            "platform": "error",
+            "error": err,
+        }))
+        return
     print(json.dumps({
         "metric": "fedavg_cifar10_cnn_rounds_per_sec",
         "value": round(ours["rounds_per_sec"], 4),
         "unit": "rounds/sec",
         "vs_baseline": round(vs, 4),
+        "platform": ours["platform"],
+        "n_devices": ours["n_devices"],
+        "rounds_timed": ours.get("rounds_timed", args.rounds),
+        "client_samples_per_sec_per_chip": round(
+            ours["client_samples_per_sec_per_chip"], 1),
     }))
 
 

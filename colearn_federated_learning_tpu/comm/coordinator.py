@@ -165,12 +165,19 @@ class FederatedCoordinator:
             return header["meta"], delta
 
         results, dropped = [], []
+        # ONE deadline for the whole round: every future races the same
+        # clock, so a bad round costs round_timeout, not cohort × timeout
+        # (the requests run concurrently; sequential per-future timeouts
+        # would stack while collecting).
+        deadline = t0 + self.round_timeout
         with cf.ThreadPoolExecutor(max_workers=max(1, len(cohort))) as pool:
             futs = {pool.submit(ask, d): d for d in cohort}
             for fut, dev in futs.items():
                 try:
-                    results.append(fut.result(timeout=self.round_timeout))
+                    remaining = max(0.0, deadline - time.perf_counter())
+                    results.append(fut.result(timeout=remaining))
                 except Exception:                     # timeout / dead peer
+                    fut.cancel()
                     dropped.append(dev.device_id)
                     self._reconnect(dev)
 

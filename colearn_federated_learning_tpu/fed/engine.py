@@ -43,7 +43,16 @@ from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 
 
 def _resolve_devices(backend: str) -> list:
-    """Device list for --backend=auto|cpu|tpu (auto prefers accelerators)."""
+    """Device list for --backend=auto|cpu|tpu (auto prefers accelerators).
+
+    ``auto`` degrades to the CPU backend when the default backend fails to
+    initialize (a flaky TPU plugin must not kill a CPU-capable run);
+    ``tpu`` stays strict and surfaces the error."""
+    if backend == "auto":
+        try:
+            return jax.devices()
+        except Exception:
+            return jax.devices("cpu")
     devices = jax.devices()
     if backend == "cpu":
         devices = [d for d in devices if d.platform == "cpu"] or jax.devices("cpu")
@@ -52,9 +61,21 @@ def _resolve_devices(backend: str) -> list:
         if not tpu:
             raise RuntimeError("--backend=tpu requested but no accelerator present")
         devices = tpu
-    elif backend != "auto":
+    else:
         raise ValueError(f"unknown backend {backend!r} (use auto|cpu|tpu)")
     return devices
+
+
+def _rank_cohort(skey, counts, k):
+    """Uniform sample of ``k`` clients WITHOUT replacement among real
+    clients: ghosts (count 0) are pushed to the end of the ranking and only
+    picked if the cohort exceeds real clients.  Pure jnp — the SAME function
+    runs traced inside the round program (fedavg paths) and eagerly on host
+    (the scaffold path, which must know the cohort before dispatch to gather
+    its variate rows); any edit applies to both."""
+    scores = jax.random.uniform(skey, counts.shape)
+    scores = scores + (counts == 0) * 1e3
+    return jnp.argsort(scores)[:k]
 
 
 class FederatedLearner:
@@ -210,11 +231,14 @@ class FederatedLearner:
             grad_sync_axes=(self.seq_axis,) if self.sp else (),
         )
         # SCAFFOLD per-client control variates: one params-shaped pytree per
-        # client, stacked on the client axis (memory = num_clients × model;
-        # intended for the cross-device cohort-sampling regime it targets).
+        # client, stacked on the client axis — resident on HOST (numpy).
+        # Each round gathers only the COHORT's variates into the jit round
+        # program and scatters the updated block back, so device memory is
+        # O(cohort × model), not O(num_clients × model) — the flagship
+        # configs (thousands of clients × ViT) never fit the full stack.
         if self.scaffold:
             self.client_c = jax.tree.map(
-                lambda w: jnp.zeros((self.num_clients,) + w.shape, w.dtype),
+                lambda w: np.zeros((self.num_clients,) + w.shape, w.dtype),
                 self.params,
             )
         else:
@@ -285,12 +309,13 @@ class FederatedLearner:
         PRNG derivation, so results are bit-identical regardless of how
         clients are placed on devices.  ``mask_cohort_ids`` is the FULL
         round cohort (all devices) that secure-agg masks pair against.
-        ``control`` / ``c_blk`` are the scaffold global variate and this
-        block's stacked per-client variates.
+        ``control`` / ``c_blk`` are the scaffold global variate and the
+        COHORT-ALIGNED block of per-client variates (one row per cohort
+        slot, gathered host-side from the full store before the call).
         Returns (weighted_delta_sum, total_weight, metrics, scaffold_extras)
         — the caller finishes aggregation either locally (vmap path) or
         with a psum (shard_map path); ``scaffold_extras`` is None or
-        ``(delta_c_uniform_sum, n_contributors, updated_c_blk)``.
+        ``(delta_c_uniform_sum, n_contributors, updated_cohort_block)``.
         """
         c = self.config.fed
         cx = jnp.take(x, local_ids, axis=0)
@@ -318,7 +343,7 @@ class FederatedLearner:
             budgets = jnp.full((self.cohort_size_local,), self.num_steps, jnp.int32)
 
         if self.scaffold:
-            c_i = jax.tree.map(lambda l: jnp.take(l, local_ids, axis=0), c_blk)
+            c_i = c_blk                      # already one row per cohort slot
             sres = jax.vmap(
                 self.local_update, in_axes=(None, 0, 0, 0, 0, 0, 0, None)
             )(params, cx, cy, ccounts, keys, budgets, c_i, control)
@@ -372,18 +397,16 @@ class FederatedLearner:
         if self.scaffold:
             uw = contrib.astype(jnp.float32)
             dc_sum = pytrees.tree_weighted_sum(sres.delta_c, uw)
-            # Refresh only contributors' variates; scatter back into the
-            # stacked block.
+            # Refresh only contributors' variates; non-contributor rows keep
+            # their old values.  The caller scatters this cohort block back
+            # into the host-resident full store.
             c_masked = jax.tree.map(
                 lambda new, old: jnp.where(
                     contrib.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
                 ),
                 sres.c_new, c_i,
             )
-            new_c_blk = jax.tree.map(
-                lambda full, upd: full.at[local_ids].set(upd), c_blk, c_masked
-            )
-            extras = (dc_sum, n_completed.astype(jnp.float32), new_c_blk)
+            extras = (dc_sum, n_completed.astype(jnp.float32), c_masked)
         return wsum, total_w, (loss_sum, n_completed), extras
 
     def _finish_round(self, server_state, wsum, total_w, loss_sum, n_comp,
@@ -415,12 +438,12 @@ class FederatedLearner:
         return new_state, metrics
 
     def _donate_argnums(self) -> tuple[int, ...]:
-        """Donate the consumed round state (server_state, client_c) so XLA
-        reuses their HBM in place — matters for big models and the stacked
-        scaffold variates.  CPU ignores donation with a warning, so skip."""
+        """Donate the consumed round state (server_state, cohort variate
+        block) so XLA reuses their HBM in place — matters for big models.
+        CPU ignores donation with a warning, so skip."""
         devs = self.mesh.devices.flat if self.mesh is not None else jax.devices()
         first = next(iter(devs))
-        return () if first.platform == "cpu" else (0, 7)
+        return () if first.platform == "cpu" else (0, 8)
 
     def _build_round_fn(self):
         c = self.config.fed
@@ -430,25 +453,26 @@ class FederatedLearner:
             self.cohort_size_local = self.cohort_size
 
             def round_fn(server_state, key, round_idx, x, y, counts, ids,
-                         client_c):
-                skey = prng.sampling_key(key, round_idx)
-                if self.cohort_size < self.num_clients:
-                    # Uniform sample WITHOUT replacement among real clients:
-                    # ghosts (count 0) are pushed to the end of the ranking
-                    # and only picked if the cohort exceeds real clients.
-                    scores = jax.random.uniform(skey, (self.num_clients,))
-                    scores = scores + (counts == 0) * 1e3
-                    sel = jnp.argsort(scores)[: self.cohort_size]
+                         sel_in, c_cohort):
+                if self.scaffold:
+                    # Cohort-resident variates: the cohort was sampled on
+                    # host (so its variate rows could be gathered) and
+                    # arrives as an operand.
+                    sel = sel_in
                 else:
-                    sel = jnp.arange(self.num_clients)
+                    skey = prng.sampling_key(key, round_idx)
+                    if self.cohort_size < self.num_clients:
+                        sel = _rank_cohort(skey, counts, self.cohort_size)
+                    else:
+                        sel = jnp.arange(self.num_clients)
                 cohort_global = jnp.take(ids, sel)
                 wsum, total_w, (loss_sum, n_comp), extras = self._cohort_step(
                     server_state.params, sel, cohort_global, cohort_global,
                     x, y, counts, key, round_idx,
-                    control=server_state.control, c_blk=client_c,
+                    control=server_state.control, c_blk=c_cohort,
                 )
                 dc_sum, n_contrib, new_c = (
-                    extras if extras is not None else (None, None, client_c)
+                    extras if extras is not None else (None, None, None)
                 )
                 new_state, metrics = self._finish_round(
                     server_state, wsum, total_w, loss_sum, n_comp,
@@ -467,18 +491,21 @@ class FederatedLearner:
         local_clients = self.num_clients // self.clients_size
 
         def body(server_state, key, round_idx, x_blk, y_blk, counts_blk,
-                 ids_blk, c_blk):
-            dev = jax.lax.axis_index(ax)
-            skey = jax.random.fold_in(prng.sampling_key(key, round_idx), dev)
-            if self.cohort_per_device < local_clients:
-                # Sample this device's slice of the cohort among its REAL
-                # clients (interleaved placement spreads reals evenly, so
-                # ghosts are only picked when the cohort exceeds them).
-                scores = jax.random.uniform(skey, (local_clients,))
-                scores = scores + (counts_blk == 0) * 1e3
-                sel = jnp.argsort(scores)[: self.cohort_per_device]
+                 ids_blk, sel_blk, c_blk):
+            if self.scaffold:
+                sel = sel_blk            # host-sampled (cohort-resident c)
             else:
-                sel = jnp.arange(local_clients)
+                dev = jax.lax.axis_index(ax)
+                skey = jax.random.fold_in(
+                    prng.sampling_key(key, round_idx), dev
+                )
+                if self.cohort_per_device < local_clients:
+                    # This device's slice of the cohort among its REAL
+                    # clients (interleaved placement spreads reals evenly).
+                    sel = _rank_cohort(skey, counts_blk,
+                                       self.cohort_per_device)
+                else:
+                    sel = jnp.arange(local_clients)
             cohort_global = jnp.take(ids_blk, sel)
             # Secure-agg masks pair against the FULL mesh-wide cohort: a
             # cheap all_gather of the (cohort_per_device,) id vectors.
@@ -498,7 +525,7 @@ class FederatedLearner:
                 dc_sum = jax.tree.map(lambda l: jax.lax.psum(l, ax), dc_sum)
                 n_contrib = jax.lax.psum(n_contrib, ax)
             else:
-                dc_sum, n_contrib, new_c = None, None, c_blk
+                dc_sum, n_contrib, new_c = None, None, None
             new_state, metrics = self._finish_round(
                 server_state, wsum, total_w, loss_sum, n_comp,
                 dc_sum=dc_sum, n_contrib=n_contrib,
@@ -507,10 +534,12 @@ class FederatedLearner:
 
         x_spec = P(ax, None, self.seq_axis) if self.sp else P(ax)
         c_spec = P(ax) if self.scaffold else P()
+        sel_spec = P(ax) if self.scaffold else P()
         sharded = shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(), P(), P(), x_spec, P(ax), P(ax), P(ax), c_spec),
+            in_specs=(P(), P(), P(), x_spec, P(ax), P(ax), P(ax), sel_spec,
+                      c_spec),
             out_specs=(P(), P(), c_spec),
             check_vma=False,
         )
@@ -530,15 +559,75 @@ class FederatedLearner:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def _host_sample_cohort(self, round_idx: int):
+        """Cohort selection on HOST — same key derivation and ranking as the
+        in-program sampler, run eagerly so the scaffold path can gather the
+        cohort's variate rows before dispatching the round.
+
+        Returns ``(sel, rows)``: ``sel`` are the per-device-local slot
+        indices the round program consumes; ``rows`` the absolute rows of
+        the (interleaved) client-stacked arrays, for host gather/scatter.
+        """
+        r = jnp.asarray(round_idx, jnp.int32)
+        counts = jnp.asarray(self.shards.counts)
+        if self.mesh is None:
+            if self.cohort_size < self.num_clients:
+                skey = prng.sampling_key(self.base_key, r)
+                sel = np.asarray(
+                    _rank_cohort(skey, counts, self.cohort_size)
+                ).astype(np.int32)
+            else:
+                sel = np.arange(self.num_clients, dtype=np.int32)
+            return sel, sel
+        D, cpd = self.clients_size, self.cohort_per_device
+        L = self.num_clients // D
+        skey = prng.sampling_key(self.base_key, r)
+        sels, rows = [], []
+        for d in range(D):
+            if cpd < L:
+                dkey = jax.random.fold_in(skey, d)
+                s = np.asarray(
+                    _rank_cohort(dkey, counts[d * L:(d + 1) * L], cpd)
+                ).astype(np.int32)
+            else:
+                s = np.arange(L, dtype=np.int32)
+            sels.append(s)
+            rows.append(d * L + s)
+        return np.concatenate(sels), np.concatenate(rows)
+
     def run_round(self) -> dict:
         r = len(self.history)
-        self.server_state, metrics, self.client_c = self._round_fn(
+        if self.scaffold:
+            # Gather the cohort's variates from the host store; scatter the
+            # refreshed block back afterwards (device memory stays
+            # O(cohort × model)).
+            sel, rows = self._host_sample_cohort(r)
+            c_cohort = jax.tree.map(lambda l: l[rows], self.client_c)
+            sel_dev = jnp.asarray(sel)
+            if self.mesh is not None:
+                sh = NamedSharding(self.mesh, P(self.client_axis))
+                sel_dev = jax.device_put(sel_dev, sh)
+                c_cohort = jax.tree.map(
+                    lambda l: jax.device_put(jnp.asarray(l), sh), c_cohort
+                )
+        else:
+            sel, rows, sel_dev, c_cohort = None, None, None, None
+        self.server_state, metrics, new_c = self._round_fn(
             self.server_state,
             self.base_key,
             jnp.asarray(r, jnp.int32),
             *self._device_data,
-            self.client_c,
+            sel_dev,
+            c_cohort,
         )
+        if self.scaffold:
+            updated = jax.tree.map(np.asarray, new_c)
+
+            def scatter(full, upd):
+                full[rows] = upd
+                return full
+
+            self.client_c = jax.tree.map(scatter, self.client_c, updated)
         out = {k: float(v) for k, v in metrics.items()}
         out["round"] = r
         self.history.append(out)
@@ -674,29 +763,35 @@ class FederatedLearner:
         from colearn_federated_learning_tpu.utils.profiling import RoundProfiler
 
         profiler = RoundProfiler(run.profile_dir)
-        for _ in range(rounds):
-            t0 = time.perf_counter()
-            profiler.before_round(len(self.history))
-            rec = self.run_round()
-            if profiler._active:
-                # The trace window must contain the round's device work —
-                # only synchronise while actually tracing (blocking every
-                # round would serialise the async dispatch pipeline).
-                jax.block_until_ready(self.server_state.params)
-            profiler.after_round(rec["round"])
-            rec["round_time_s"] = time.perf_counter() - t0
-            if rec["round"] % eval_every == 0 or rec["round"] == last_round:
-                loss, acc = self.evaluate()
-                rec["eval_loss"], rec["eval_acc"] = loss, acc
-            if log_fn is not None and (
-                rec["round"] % log_every == 0 or rec["round"] == last_round
-            ):
-                log_fn(rec)
-            # With a checkpoint_dir, the final round ALWAYS checkpoints even
-            # when no periodic cadence is configured, so --resume works.
-            if want_ckpt and (
-                (ckpt_every and (rec["round"] + 1) % ckpt_every == 0)
-                or rec["round"] == last_round
-            ):
-                self.save_checkpoint()
+        try:
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                profiler.before_round(len(self.history))
+                rec = self.run_round()
+                if profiler._active:
+                    # The trace window must contain the round's device work —
+                    # only synchronise while actually tracing (blocking every
+                    # round would serialise the async dispatch pipeline).
+                    jax.block_until_ready(self.server_state.params)
+                profiler.after_round(rec["round"])
+                rec["round_time_s"] = time.perf_counter() - t0
+                if rec["round"] % eval_every == 0 or rec["round"] == last_round:
+                    loss, acc = self.evaluate()
+                    rec["eval_loss"], rec["eval_acc"] = loss, acc
+                if log_fn is not None and (
+                    rec["round"] % log_every == 0 or rec["round"] == last_round
+                ):
+                    log_fn(rec)
+                # With a checkpoint_dir, the final round ALWAYS checkpoints
+                # even when no periodic cadence is configured, so --resume
+                # works.
+                if want_ckpt and (
+                    (ckpt_every and (rec["round"] + 1) % ckpt_every == 0)
+                    or rec["round"] == last_round
+                ):
+                    self.save_checkpoint()
+        finally:
+            # An exception mid-window (eval/log/ckpt) must not leave the
+            # process-global jax profiler trace running.
+            profiler.close()
         return self.history

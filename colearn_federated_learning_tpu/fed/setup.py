@@ -70,6 +70,14 @@ def local_trainer_for_config(
             "scaffold's option-II variate refresh assumes plain SGD steps; "
             f"local_optimizer={c.local_optimizer!r} is unsupported"
         )
+    if c.strategy == "scaffold" and c.momentum != 0.0:
+        # Option-II refresh c_i' = c_i - c + (w_g - w_l)/(K*lr) equals the
+        # mean corrected gradient ONLY under vanilla SGD; momentum silently
+        # biases the variates (and the default config carries momentum=0.9).
+        raise ValueError(
+            "scaffold requires momentum=0.0: the option-II control-variate "
+            f"refresh is biased under momentum (got momentum={c.momentum})"
+        )
     num_steps = num_steps_for_config(config, capacity)
     optimizer = local_lib.make_optimizer(c.lr, c.momentum, c.local_optimizer)
     update_fn = local_lib.make_local_update(

@@ -19,8 +19,6 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
-ABI_VERSION = 1
-
 
 def load() -> Optional[ctypes.CDLL]:
     """The native library, building it on first use; None if unavailable."""
@@ -32,15 +30,36 @@ def load() -> Optional[ctypes.CDLL]:
         if os.environ.get("COLEARN_NO_NATIVE"):
             return None
         try:
+            import shutil
+
             from colearn_federated_learning_tpu.native import build as build_mod
 
             if build_mod.needs_build():
                 build_mod.build()
             lib = ctypes.CDLL(str(build_mod.LIB))
             lib.cl_abi_version.restype = ctypes.c_int
-            if lib.cl_abi_version() != ABI_VERSION:
-                build_mod.build()           # stale cache: rebuild once
-                lib = ctypes.CDLL(str(build_mod.LIB))
+            if lib.cl_abi_version() != build_mod.ABI_VERSION:
+                # The versioned filename makes this near-impossible (a new
+                # ABI gets a new name), but if a same-name binary still
+                # mismatches, rebuild and dlopen a process-unique COPY —
+                # re-opening the original path would hand back the stale
+                # handle this process already holds.
+                build_mod.build()
+                fresh = build_mod.LIB.with_name(
+                    f"{build_mod.LIB.stem}.pid{os.getpid()}.so"
+                )
+                shutil.copy2(build_mod.LIB, fresh)
+                lib = ctypes.CDLL(str(fresh))
+                # The dlopen handle keeps the inode alive; unlink so the
+                # per-process copies never accumulate in _build.
+                try:
+                    fresh.unlink()
+                except OSError:
+                    pass
+                lib.cl_abi_version.restype = ctypes.c_int
+                if lib.cl_abi_version() != build_mod.ABI_VERSION:
+                    _lib = None
+                    return _lib
             lib.cl_gather_rows.restype = ctypes.c_int
             lib.cl_gather_rows.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,

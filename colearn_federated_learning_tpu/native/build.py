@@ -13,7 +13,12 @@ import subprocess
 
 _ROOT = pathlib.Path(__file__).resolve().parent
 SOURCES = [_ROOT / "src" / "gather.cpp"]
-LIB = _ROOT / "_build" / "libcolearn_native.so"
+# The ABI version is part of the FILENAME: a checkout upgrade can never
+# dlopen a stale cached binary under the new name, and a rebuild after a
+# runtime version mismatch loads from a fresh path (re-dlopening the same
+# path would return the stale handle already held by the process).
+ABI_VERSION = 1
+LIB = _ROOT / "_build" / f"libcolearn_native_v{ABI_VERSION}.so"
 
 
 def needs_build() -> bool:
@@ -28,6 +33,12 @@ def build(verbose: bool = False) -> pathlib.Path:
     if cxx is None:
         raise RuntimeError("no C++ compiler found")
     LIB.parent.mkdir(parents=True, exist_ok=True)
+    for stale in LIB.parent.glob("*.so"):
+        if stale.name != LIB.name:     # older ABI / pre-versioning binaries
+            try:
+                stale.unlink()
+            except OSError:
+                pass
     cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
            *map(str, SOURCES), "-o", str(LIB)]
     if verbose:

@@ -17,19 +17,28 @@ from jax.sharding import Mesh
 
 
 def factor_devices(n: int, num_axes: int) -> tuple[int, ...]:
-    """Factor ``n`` devices into ``num_axes`` mesh-axis sizes, largest first.
+    """Factor ``n`` devices into ``num_axes`` mesh-axis sizes.
 
-    Greedy: peel off the largest power-of-two-ish divisor per axis so early
-    axes (typically the client/data axis) get the most devices.
+    Greedy: trailing axes take the smallest divisor > 1 so the leading
+    (client/data) axis keeps the bulk — EXCEPT when the remainder is prime
+    (incl. 2): then the whole remainder goes to the trailing axis, e.g.
+    ``factor_devices(7, 2) == (1, 7)``, so a ring (``seq``) axis is never
+    a useless size-1 axis.
     """
     if num_axes <= 0:
         raise ValueError("num_axes must be >= 1")
     sizes = []
     remaining = n
     for _ in range(num_axes - 1):
-        # smallest PROPER divisor > 1 for the trailing axes, so the leading
-        # axis keeps the bulk; primes (no proper divisor) give a size-1 axis
-        d = next((f for f in range(2, remaining) if remaining % f == 0), 1)
+        # Smallest divisor > 1 for the trailing axes, so the leading axis
+        # keeps the bulk.  When ``remaining`` is prime (incl. 2) the WHOLE
+        # remainder goes to the trailing axis rather than a useless size-1
+        # axis — a (1, n) mesh still gives ring attention a real ``seq``
+        # ring, whereas (n, 1) broke ``attn_impl="ring"`` auto-meshing.
+        d = next(
+            (f for f in range(2, remaining) if remaining % f == 0),
+            remaining if remaining > 1 else 1,
+        )
         sizes.append(d)
         remaining //= d
     sizes.append(remaining)

@@ -121,9 +121,17 @@ def test_cli_bench_parses_forwarded_args(monkeypatch, capsys):
     # workload functions and check the wiring end-to-end.
     from colearn_federated_learning_tpu import bench
 
-    monkeypatch.setattr(bench, "run_tpu_native",
-                        lambda rounds, warmup: {"rounds_per_sec": float(rounds)})
+    monkeypatch.setattr(bench, "probe_platform", lambda timeout_s: "tpu")
+    monkeypatch.setattr(
+        bench, "run_tpu_native",
+        lambda rounds, warmup, workload=None: {
+            "rounds_per_sec": float(rounds),
+            "client_samples_per_sec_per_chip": 1.0,
+            "n_devices": 1,
+            "platform": "tpu",
+        })
     rc = cli.main(["bench", "--rounds", "3", "--skip-baseline"])
     assert rc == 0
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["value"] == 3.0 and rec["unit"] == "rounds/sec"
+    assert rec["platform"] == "tpu"

@@ -25,7 +25,7 @@ def test_factor_devices():
     assert factor_devices(8, 2) == (4, 2)
     assert factor_devices(8, 3) == (2, 2, 2)
     assert factor_devices(6, 2) == (3, 2)
-    assert factor_devices(7, 2) == (7, 1)
+    assert factor_devices(7, 2) == (1, 7)  # prime: trailing axis gets all
     assert factor_devices(1, 2) == (1, 1)
 
 

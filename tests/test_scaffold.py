@@ -155,3 +155,35 @@ def test_scaffold_checkpoint_roundtrip(tmp_path):
         np.asarray(jax.tree.leaves(b.client_c)[0]),
     )
     b.run_round()                              # resumes cleanly
+
+
+def test_scaffold_variates_are_cohort_resident(mesh8):
+    """Flagship regime: many clients, small cohort.  The full variate store
+    must live on HOST (numpy) and the round program must only ever see the
+    cohort block — num_clients=512 x model on-device would not fit the
+    flagship configs."""
+    cfg = _cfg(num_clients=512, cohort=16)
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, partition="iid"))
+    learner = FederatedLearner(cfg, mesh=mesh8)
+    # host-resident store, full size
+    leaves = jax.tree.leaves(learner.client_c)
+    assert all(isinstance(l, np.ndarray) for l in leaves)
+    assert all(l.shape[0] == learner.num_clients for l in leaves)
+
+    before = jax.tree.map(np.array, learner.client_c)
+    rec = learner.run_round()
+    assert rec["completed"] >= 1
+
+    # exactly the sampled cohort's rows changed
+    _, rows = learner._host_sample_cohort(0)
+    changed = set()
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(learner.client_c)):
+        diff = np.abs(a - b).reshape(a.shape[0], -1).sum(axis=1)
+        changed |= set(np.nonzero(diff)[0].tolist())
+    assert changed, "no variates moved"
+    assert changed <= set(rows.tolist())
+    assert len(changed) <= learner.cohort_size
+
+    # and the jitted round program's variate operand is cohort-sized
+    sel, rows = learner._host_sample_cohort(1)
+    assert sel.shape[0] == learner.cohort_size == 16

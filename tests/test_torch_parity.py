@@ -1,0 +1,108 @@
+"""Numerical parity of the jit-compiled local SGD with torch.optim.SGD
+(SURVEY.md §7 hard part #4: "optimizer parity with the reference's PyTorch
+SGD").  Same init, same data, same batch schedule, same lr/momentum — the
+optax trajectory must track the torch trajectory to float32 round-off."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from colearn_federated_learning_tpu.fed import local as local_lib  # noqa: E402
+from colearn_federated_learning_tpu.models import registry as model_registry  # noqa: E402
+from colearn_federated_learning_tpu.utils.config import ModelConfig  # noqa: E402
+
+STEPS = 20
+BATCH = 16
+LR = 0.05
+HIDDEN = 32
+DEPTH = 2
+N = 64  # shard size
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(N,)).astype(np.int32)
+    return x, y
+
+
+def _batch_schedule(key, count):
+    """The EXACT per-step index draw make_local_update performs."""
+    idx = []
+    for t in range(STEPS):
+        k = jax.random.fold_in(key, t)
+        idx.append(np.asarray(jax.random.randint(k, (BATCH,), 0, count)))
+    return idx
+
+
+def _torch_mlp_from_flax(params):
+    """Torch twin of models/mlp.py with the flax init COPIED in (flax Dense
+    kernels are (in, out); torch Linear weights are (out, in))."""
+    layers = []
+    dims = [28 * 28] + [HIDDEN] * DEPTH + [10]
+    for i in range(DEPTH + 1):
+        lin = tnn.Linear(dims[i], dims[i + 1])
+        p = params[f"Dense_{i}"]
+        with torch.no_grad():
+            lin.weight.copy_(torch.from_numpy(np.asarray(p["kernel"]).T))
+            lin.bias.copy_(torch.from_numpy(np.asarray(p["bias"])))
+        layers.append(lin)
+        if i < DEPTH:
+            layers.append(tnn.ReLU())
+    return tnn.Sequential(*layers)
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_local_sgd_matches_torch(momentum):
+    x, y = _data()
+    model = model_registry.build_model(
+        ModelConfig(name="mlp", num_classes=10, hidden_dim=HIDDEN, depth=DEPTH)
+    )
+    key = jax.random.PRNGKey(0)
+    params = model_registry.init_params(model, jnp.asarray(x[:BATCH]), key)
+
+    # ---- optax path: the real jit-compiled local round ------------------
+    opt = local_lib.make_optimizer(LR, momentum)
+    update = local_lib.make_local_update(
+        model.apply, opt, num_steps=STEPS, batch_size=BATCH,
+    )
+    data_key = jax.random.PRNGKey(42)
+    result = jax.jit(update)(
+        params, jnp.asarray(x), jnp.asarray(y),
+        jnp.asarray(N, jnp.int32), data_key,
+        jnp.asarray(STEPS, jnp.int32),
+    )
+    ours = jax.tree.map(lambda p, d: np.asarray(p + d), params, result.delta)
+
+    # ---- torch path: identical schedule, torch.optim.SGD ----------------
+    tmodel = _torch_mlp_from_flax(params)
+    topt = torch.optim.SGD(tmodel.parameters(), lr=LR, momentum=momentum)
+    loss_fn = tnn.CrossEntropyLoss()
+    losses_t = []
+    for idx in _batch_schedule(data_key, N):
+        xb = torch.from_numpy(x[idx].reshape(BATCH, -1))
+        yb = torch.from_numpy(y[idx].astype(np.int64))
+        topt.zero_grad()
+        loss = loss_fn(tmodel(xb), yb)
+        loss.backward()
+        topt.step()
+        losses_t.append(loss.item())
+
+    # ---- trajectories agree ---------------------------------------------
+    # mean over executed steps matches the torch per-step loss mean
+    np.testing.assert_allclose(
+        float(result.mean_loss), np.mean(losses_t), rtol=1e-5, atol=1e-6
+    )
+    lins = [m for m in tmodel if isinstance(m, tnn.Linear)]
+    for i, lin in enumerate(lins):
+        ref_w = lin.weight.detach().numpy().T
+        ref_b = lin.bias.detach().numpy()
+        got = ours[f"Dense_{i}"]
+        np.testing.assert_allclose(got["kernel"], ref_w, rtol=1e-4, atol=2e-5)
+        np.testing.assert_allclose(got["bias"], ref_b, rtol=1e-4, atol=2e-5)
