@@ -19,6 +19,14 @@ import orbax.checkpoint as ocp
 class RoundCheckpointer:
     """Save/restore (server_state, history) keyed by round number."""
 
+    @classmethod
+    def for_run(cls, run_config) -> "RoundCheckpointer":
+        """Checkpointer for a RunConfig — the one place the
+        checkpoint-dir-required validation lives (engine + coordinator)."""
+        if not run_config.checkpoint_dir:
+            raise ValueError("config.run.checkpoint_dir is not set")
+        return cls(run_config.checkpoint_dir)
+
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)

@@ -50,6 +50,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dirichlet-alpha", type=float, default=None)
     p.add_argument("--dp-clip", type=float, default=None)
     p.add_argument("--dp-noise-multiplier", type=float, default=None)
+    p.add_argument("--dp-delta", type=float, default=None,
+                   help="δ at which the RDP accountant reports ε")
     p.add_argument("--secure-agg", action="store_true", default=None)
     p.add_argument("--compress", default=None, choices=["none", "int8"],
                    help="update compression on the wire/file planes")
@@ -67,8 +69,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
 
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "batch_size", "lr", "momentum", "local_optimizer", "strategy",
-             "prox_mu", "dp_clip", "dp_noise_multiplier", "secure_agg",
-             "straggler_prob", "compress"}
+             "prox_mu", "dp_clip", "dp_noise_multiplier", "dp_delta",
+             "secure_agg", "straggler_prob", "compress"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}
@@ -212,6 +214,9 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
                                  round_timeout=args.round_timeout,
                                  want_evaluator=not args.no_evaluator)
     with coord:
+        if args.resume:
+            step = coord.restore_checkpoint()
+            print(f"resumed at round {step}", file=sys.stderr)
         coord.enroll(min_devices=args.min_devices,
                      timeout=args.enroll_timeout)
         hist = coord.fit(log_fn=lambda rec: print(json.dumps(rec),
@@ -302,6 +307,9 @@ def main(argv: list[str] | None = None) -> int:
     p_coord.add_argument("--no-evaluator", action="store_true")
     p_coord.add_argument("--elastic", action="store_true",
                          help="admit late-joining workers between rounds")
+    p_coord.add_argument("--resume", action="store_true",
+                         help="restore the latest checkpoint from "
+                              "--checkpoint-dir before training")
     p_coord.set_defaults(fn=cmd_coordinate)
 
     p_bench = sub.add_parser("bench", help="run the headline benchmark")

@@ -59,6 +59,16 @@ class FederatedCoordinator:
         self.evaluator: Optional[DeviceInfo] = None
         self._fail_counts: dict[str, int] = {}
         self.evict_after = 3          # consecutive failed rounds → evicted
+        self._ckpt = None
+        # RDP accounting mirrors the engine's; each round is charged with
+        # the ACTUAL cohort fraction and REALIZED noise (membership is
+        # elastic here and stragglers drop mid-round).
+        from colearn_federated_learning_tpu.privacy.accountant import (
+            RdpAccountant,
+        )
+
+        self.accountant = RdpAccountant.from_config(config.fed,
+                                                    sampling_rate=1.0)
 
     # ------------------------------------------------------------------
     def enroll(self, min_devices: int, timeout: float = 30.0) -> None:
@@ -74,6 +84,9 @@ class FederatedCoordinator:
         for c in self._clients.values():
             c.close()
         self._broker.close()
+        if self._ckpt is not None:
+            self._ckpt.close()
+            self._ckpt = None
 
     def __enter__(self):
         return self
@@ -212,6 +225,27 @@ class FederatedCoordinator:
             "total_weight": total_w,
             "round_time_s": time.perf_counter() - t0,
         }
+        if self.accountant is not None:
+            # Workers calibrate per-client noise to the NOMINAL cohort
+            # (fed/setup.py finalize_client_delta), so with only ``folded``
+            # contributors the realized central noise is
+            # σ·C·sqrt(folded/nominal) — charge THAT, not nominal σ, or ε
+            # under-reports whenever enrollment or completion falls short.
+            # A round that released no aggregate (folded == 0) costs nothing.
+            if folded > 0:
+                import math
+
+                nominal = max(
+                    self.config.fed.cohort_size or self.config.data.num_clients,
+                    1,
+                )
+                sigma_eff = (self.config.fed.dp_noise_multiplier
+                             * math.sqrt(min(folded, nominal) / nominal))
+                q = len(cohort) / max(1, len(self.trainers))
+                self.accountant.step(sampling_rate=q,
+                                     noise_multiplier=sigma_eff)
+            rec["dp_epsilon"] = self.accountant.epsilon()
+            rec["dp_delta"] = self.accountant.delta
         self.history.append(rec)
         return rec
 
@@ -227,22 +261,74 @@ class FederatedCoordinator:
             raise RuntimeError(f"evaluator failed: {header.get('error')}")
         return header["meta"]
 
+    # ---- checkpoint/resume (same RoundCheckpointer as the engine) --------
+    def _checkpointer(self):
+        if self._ckpt is None:
+            from colearn_federated_learning_tpu.ckpt import RoundCheckpointer
+
+            self._ckpt = RoundCheckpointer.for_run(self.config.run)
+        return self._ckpt
+
+    def _acct_rdp(self) -> np.ndarray:
+        # orbax refuses zero-size arrays, so "no accountant" is a (1,) zero.
+        return (self.accountant.total_rdp if self.accountant is not None
+                else np.zeros(1))
+
+    def save_checkpoint(self) -> None:
+        # The accumulated RDP vector rides along: per-round sampling rates
+        # vary with membership, so ε cannot be reconstructed from a round
+        # count the way the constant-mechanism engine does.
+        self._checkpointer().save(
+            len(self.history), (self.server_state, self._acct_rdp()),
+            self.history,
+        )
+
+    def restore_checkpoint(self) -> int:
+        """Restore the latest checkpoint; returns the resumed round index.
+        A killed ``colearn coordinate`` run picks up exactly where it
+        stopped — workers are stateless between rounds (they receive the
+        global params every round), so only the coordinator's server state,
+        history and privacy budget need to survive."""
+        state, history, step = self._checkpointer().restore(
+            (self.server_state, self._acct_rdp())
+        )
+        self.server_state, acct_rdp = state
+        self.history = history
+        if self.accountant is not None:
+            self.accountant.total_rdp = np.asarray(acct_rdp)
+            self.accountant._steps = step
+        return step
+
     def fit(self, rounds: Optional[int] = None, log_fn=None,
             eval_every: Optional[int] = None,
             elastic: bool = False) -> list[dict]:
         """``elastic=True`` polls enrollment between rounds so late-joining
-        devices are admitted mid-run."""
-        rounds = rounds if rounds is not None else self.config.fed.rounds
+        devices are admitted mid-run.  ``rounds=None`` runs the REMAINING
+        ``config.fed.rounds - len(history)`` rounds, so a restored
+        coordinator finishes its original budget rather than restarting."""
+        if rounds is None:
+            rounds = max(0, self.config.fed.rounds - len(self.history))
         eval_every = eval_every or self.config.run.eval_every
+        run = self.config.run
+        ckpt_every = max(0, run.checkpoint_every)
+        want_ckpt = bool(run.checkpoint_dir)
+        last_round = len(self.history) + rounds - 1
         for _ in range(rounds):
             if elastic:
                 self.refresh_membership()
             rec = self.run_round()
             if self.evaluator is not None and (
                 rec["round"] % max(1, eval_every) == 0
-                or rec["round"] == rounds - 1
+                or rec["round"] == last_round
             ):
                 rec.update(self.evaluate())
             if log_fn is not None:
                 log_fn(rec)
+            # Like the engine: with a checkpoint_dir the final round always
+            # checkpoints, so --resume works without a periodic cadence.
+            if want_ckpt and (
+                (ckpt_every and (rec["round"] + 1) % ckpt_every == 0)
+                or rec["round"] == last_round
+            ):
+                self.save_checkpoint()
         return self.history

@@ -267,6 +267,16 @@ class FederatedLearner:
         # drop mid-round the realized central noise is below nominal — a
         # known property of DP-FedAvg with dropouts; see privacy/dp.py.
         self.dp_cohort = min(self.cohort_size, self.real_num_clients)
+        # RDP accountant: cumulative (ε, δ) per round when DP is on
+        # (privacy/accountant.py; each round is one subsampled Gaussian
+        # mechanism with q = cohort / N at central noise σ).
+        from colearn_federated_learning_tpu.privacy.accountant import (
+            RdpAccountant,
+        )
+
+        self.accountant = RdpAccountant.from_config(
+            c.fed, sampling_rate=self.dp_cohort / self.real_num_clients
+        )
 
         # --- compiled programs ---------------------------------------
         self.base_key = prng.experiment_key(c.run.seed)
@@ -630,6 +640,10 @@ class FederatedLearner:
             self.client_c = jax.tree.map(scatter, self.client_c, updated)
         out = {k: float(v) for k, v in metrics.items()}
         out["round"] = r
+        if self.accountant is not None:
+            self.accountant.step()
+            out["dp_epsilon"] = self.accountant.epsilon()
+            out["dp_delta"] = self.accountant.delta
         self.history.append(out)
         return out
 
@@ -726,9 +740,7 @@ class FederatedLearner:
         if self._ckpt is None:
             from colearn_federated_learning_tpu.ckpt import RoundCheckpointer
 
-            if not self.config.run.checkpoint_dir:
-                raise ValueError("config.run.checkpoint_dir is not set")
-            self._ckpt = RoundCheckpointer(self.config.run.checkpoint_dir)
+            self._ckpt = RoundCheckpointer.for_run(self.config.run)
         return self._ckpt
 
     def save_checkpoint(self) -> None:
@@ -745,6 +757,9 @@ class FederatedLearner:
         )
         self.server_state, self.client_c = state
         self.history = history
+        if self.accountant is not None:
+            # ε must account for every round already spent before the kill.
+            self.accountant.steps = step
         return step
 
     def fit(self, rounds: Optional[int] = None, log_fn=None) -> list[dict]:

@@ -1,4 +1,8 @@
-"""On-device privacy hooks: DP clip+noise and secure-aggregation masking."""
+"""On-device privacy hooks: DP clip+noise, secure-aggregation masking, and
+RDP (ε, δ) accounting."""
 
+from colearn_federated_learning_tpu.privacy.accountant import (  # noqa: F401
+    RdpAccountant,
+)
 from colearn_federated_learning_tpu.privacy.dp import clip_and_noise  # noqa: F401
 from colearn_federated_learning_tpu.privacy.secure_agg import pairwise_mask  # noqa: F401

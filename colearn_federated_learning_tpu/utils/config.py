@@ -62,6 +62,7 @@ class FedConfig:
     # Privacy hooks (BASELINE.json north_star: on-device DP + secure agg).
     dp_clip: float = 0.0              # 0 disables clipping
     dp_noise_multiplier: float = 0.0  # Gaussian sigma = mult * clip
+    dp_delta: float = 1e-5            # δ at which the accountant reports ε
     secure_agg: bool = False
     # Update compression on the wire/file planes (fed/compression.py).
     compress: str = "none"            # none | int8

@@ -276,3 +276,48 @@ def test_socket_federation_with_int8_compression():
         finally:
             for w in workers:
                 w.stop()
+
+
+def test_coordinator_checkpoint_kill_and_resume(tmp_path):
+    """SURVEY.md §5 checkpoint/resume for the SOCKET plane: a coordinator
+    that dies mid-run is rebuilt from its checkpoint dir and finishes the
+    original round budget with the same server state."""
+    import dataclasses
+
+    cfg = _config(num_clients=3, rounds=4)
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=1))
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=3, timeout=20.0)
+            coord.fit(rounds=2)                  # checkpoints each round
+            params_at_kill = {
+                k: np.array(v) for k, v in
+                coord.server_state.params["Dense_0"].items()
+            }
+            coord.close()                        # "kill" the coordinator
+
+            # Fresh process stand-in: new coordinator, same config/dir.
+            coord2 = FederatedCoordinator(cfg, broker.host, broker.port,
+                                          round_timeout=60.0,
+                                          want_evaluator=False)
+            step = coord2.restore_checkpoint()
+            assert step == 2 and len(coord2.history) == 2
+            for k, v in coord2.server_state.params["Dense_0"].items():
+                np.testing.assert_array_equal(np.asarray(v),
+                                              params_at_kill[k])
+            coord2.enroll(min_devices=3, timeout=20.0)
+            hist = coord2.fit()                  # finishes rounds 2..3 only
+            assert [r["round"] for r in hist] == [0, 1, 2, 3]
+            assert all(r["completed"] == 3 for r in hist[2:])
+            coord2.close()
+        finally:
+            for w in workers:
+                w.stop()
