@@ -9,9 +9,11 @@ parallel/ring.py; composing the two (ring outside, flash inside each block)
 is the standard long-context stack.
 
 Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
-pass recomputes attention densely from the (q, k, v, mask) residuals —
-exact gradients, forward-pass memory savings.  (A fused backward kernel is
-a future optimisation, not a correctness gap.)
+pass is ALSO blockwise Pallas (FlashAttention-2 recurrence): the forward
+additionally emits the per-row logsumexp, and two kernels recompute
+probabilities tile-by-tile — one accumulating dQ over k-blocks, one
+accumulating dK/dV over q-blocks — so the (L, L) score matrix never exists
+in either direction.
 
 On CPU (the virtual-mesh test platform) the kernel runs in Pallas interpret
 mode automatically.
@@ -38,15 +40,19 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
                   block_k: int, scale: float, causal: bool, block_q: int):
     """One (batch·head, q-block) tile; K/V for the whole row are VMEM-resident.
 
     q_ref: (1, block_q, D) — this tile's queries
     k_ref, v_ref: (1, Lk, D) — all keys/values for this batch·head
-    bias_ref: (1, 1, Lk) — additive key bias (0 valid / _NEG masked); rank 3
-      so its block's trailing dims satisfy the TPU (8, 128) tiling rule
+    bias_ref: (1, Lk, 1) — additive key bias (0 valid / _NEG masked).  The
+      sequence dim sits on the SUBLANE axis with a singleton lane dim:
+      Mosaic requires a block's lane dim be 128-divisible or span the whole
+      array, and in-kernel dynamic slices must be lane-aligned — k-block
+      offsets are only 8-aligned, which the sublane axis accepts.
     o_ref: (1, block_q, D)
+    lse_ref: (1, block_q, 1) — per-row logsumexp, the backward residual
     """
     Lk = k_ref.shape[1]
     D = q_ref.shape[2]
@@ -61,7 +67,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (bq, bk)
-        s = s + bias_ref[0, 0, pl.ds(kb * block_k, block_k)][None, :]
+        s = s + bias_ref[0, pl.ds(kb * block_k, block_k), 0][None, :]
         if causal:
             q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -87,10 +93,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
     acc0 = jnp.zeros((q.shape[0], D), jnp.float32)
     m, l, acc = lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    # Fully-masked rows (l == 0) get lse = +BIG so the backward's
+    # exp(s - lse) recomputation yields exactly-zero probabilities there.
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), -_NEG)
+    lse_ref[0] = lse
 
 
-def _flash_impl(q, k, v, kv_mask, causal: bool,
-                block_q: int, block_k: int, interpret: Optional[bool]):
+def _blocks(q, k, v, kv_mask, block_q, block_k, interpret):
+    """Shared fwd/bwd plumbing: row-major (B·H, L, D) views padded to block
+    multiples, the additive key bias, and resolved block sizes."""
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     if interpret is None:
@@ -105,32 +116,204 @@ def _flash_impl(q, k, v, kv_mask, causal: bool,
         a = jnp.pad(a, ((0, 0), (0, L_p - a.shape[1]), (0, 0), (0, 0)))
         return a.transpose(0, 2, 1, 3).reshape(B * H, L_p, a.shape[-1])
 
-    qr, kr, vr = to_rows(q, Lq_p), to_rows(k, Lk_p), to_rows(v, Lk_p)
     if kv_mask is None:
         bias = jnp.zeros((B, Lk), jnp.float32)
     else:
         bias = jnp.where(kv_mask, 0.0, _NEG).astype(jnp.float32)
     bias = jnp.pad(bias, ((0, 0), (0, Lk_p - Lk)), constant_values=_NEG)
-    bias = bias[:, None, :]                                   # (B, 1, Lk_p)
+    bias = bias[:, :, None]                                   # (B, Lk_p, 1)
+    return (B, Lq, H, D, Lk, bq, bk, Lq_p, Lk_p, to_rows, bias, interpret)
+
+
+def _flash_impl(q, k, v, kv_mask, causal: bool,
+                block_q: int, block_k: int, interpret: Optional[bool],
+                return_lse: bool = False):
+    (B, Lq, H, D, Lk, bq, bk, Lq_p, Lk_p, to_rows, bias,
+     interpret) = _blocks(q, k, v, kv_mask, block_q, block_k, interpret)
+    qr, kr, vr = to_rows(q, Lq_p), to_rows(k, Lk_p), to_rows(v, Lk_p)
 
     kernel = functools.partial(
         _flash_kernel, block_k=bk, scale=1.0 / (D ** 0.5),
         causal=causal, block_q=bq,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Lq_p // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Lk_p, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Lk_p, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, Lk_p), lambda b, i: (b // H, 0, 0)),
+            pl.BlockSpec((1, Lk_p, 1), lambda b, i: (b // H, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Lq_p, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, bias)
+    out = out.reshape(B, H, Lq_p, D).transpose(0, 2, 1, 3)[:, :Lq]
+    if return_lse:
+        return out, lse                                    # lse stays padded
+    return out
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref, *, block_k: int, scale: float,
+                     causal: bool, block_q: int):
+    """dQ for one (batch·head, q-block) tile, looping over k-blocks:
+    p = exp(qk^T·s + bias − lse);  ds = p ⊙ (dO·V^T − Δ);  dq += ds·K·s."""
+    Lk = k_ref.shape[1]
+    num_kb = Lk // block_k
+    qb = pl.program_id(1)
+
+    qs = q_ref[0].astype(jnp.float32) * scale                # (bq, D)
+    do = do_ref[0].astype(jnp.float32)                       # (bq, D)
+    lse = lse_ref[0]                                         # (bq, 1)
+    delta = delta_ref[0]                                     # (bq, 1)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        s = s + bias_ref[0, pl.ds(kb * block_k, block_k), 0][None, :]
+        if causal:
+            q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        p = jnp.exp(s - lse)                                 # exact softmax
+        p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+        dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    if causal:
+        num_kb = jnp.minimum(num_kb, pl.cdiv((qb + 1) * block_q, block_k))
+    dq = lax.fori_loop(
+        0, num_kb, body, jnp.zeros((qs.shape[0], qs.shape[1]), jnp.float32)
+    )
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                      delta_ref, dk_ref, dv_ref, *, block_q: int,
+                      scale: float, causal: bool, block_k: int):
+    """dK/dV for one (batch·head, k-block) tile, looping over q-blocks:
+    dv += p^T·dO;  dk += ds^T·(q·s)."""
+    Lq = q_ref.shape[1]
+    num_qb = Lq // block_q
+    kb = pl.program_id(1)
+
+    k_blk = k_ref[0].astype(jnp.float32)                     # (bk, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    bias = bias_ref[0, :, 0][None, :]                        # (1, bk)
+
+    def body(qb, carry):
+        dk, dv = carry
+        qs = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]    # (bq, 1)
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)   # (bq, bk)
+        s = s + bias
+        if causal:
+            q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        p = jnp.exp(s - lse)
+        p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+        dv_new = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    qb0 = 0
+    if causal:
+        # q-blocks strictly above the diagonal contribute nothing.
+        qb0 = (kb * block_k) // block_q
+    D = k_blk.shape[1]
+    dk, dv = lax.fori_loop(
+        qb0, num_qb, body,
+        (jnp.zeros((k_blk.shape[0], D), jnp.float32),
+         jnp.zeros((k_blk.shape[0], D), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, kv_mask, out, lse, g, causal,
+                    block_q, block_k, interpret):
+    (B, Lq, H, D, Lk, bq, bk, Lq_p, Lk_p, to_rows, bias,
+     interpret) = _blocks(q, k, v, kv_mask, block_q, block_k, interpret)
+    qr, kr, vr = to_rows(q, Lq_p), to_rows(k, Lk_p), to_rows(v, Lk_p)
+    gr = to_rows(g, Lq_p)
+    # Δ = rowsum(dO ⊙ O): tiny, batched — plain XLA, not worth a kernel.
+    # Padded query rows have g = 0, so their Δ and ds vanish.
+    outr = to_rows(out, Lq_p)
+    delta = jnp.sum(gr.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1)[:, :, None]                     # (B·H, Lq_p, 1)
+
+    scale = 1.0 / (D ** 0.5)
+    dq_kernel = functools.partial(_flash_dq_kernel, block_k=bk, scale=scale,
+                                  causal=causal, block_q=bq)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, Lq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Lk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Lk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Lk_p, 1), lambda b, i: (b // H, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Lq_p, D), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, bias)
-    return out.reshape(B, H, Lq_p, D).transpose(0, 2, 1, 3)[:, :Lq]
+    )(qr, kr, vr, bias, gr, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=bq, scale=scale,
+                                   causal=causal, block_k=bk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Lk_p // bk),
+        in_specs=[
+            pl.BlockSpec((1, Lq_p, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b, j: (b // H, j, 0)),
+            pl.BlockSpec((1, Lq_p, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Lq_p, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Lq_p, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Lk_p, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, bias, gr, lse, delta)
+
+    def from_rows(a, L, L_p):
+        return a.reshape(B, H, L_p, a.shape[-1]).transpose(0, 2, 1, 3)[:, :L]
+
+    return (from_rows(dq, Lq, Lq_p), from_rows(dk, Lk, Lk_p),
+            from_rows(dv, Lk, Lk_p))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -139,21 +322,18 @@ def _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
-    out = _flash_impl(q, k, v, kv_mask, causal, block_q, block_k, interpret)
-    return out, (q, k, v, kv_mask)
+    out, lse = _flash_impl(q, k, v, kv_mask, causal, block_q, block_k,
+                           interpret, return_lse=True)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    # Dense recompute from residuals: exact gradients, no stored (L, L)
-    # forward activations.
-    from colearn_federated_learning_tpu.parallel.ring import dense_attention
-
-    q, k, v, kv_mask = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dense_attention(q, k, v, kv_mask, causal=causal),
-        q, k, v,
-    )
-    dq, dk, dv = vjp(g)
+    # Blockwise Pallas backward (FlashAttention-2): probabilities are
+    # recomputed tile-by-tile from the saved logsumexp — exact gradients,
+    # no (L, L) matrix in either direction.
+    q, k, v, kv_mask, out, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, kv_mask, out, lse, g, causal,
+                                 block_q, block_k, interpret)
     return dq, dk, dv, None
 
 

@@ -1,0 +1,131 @@
+"""Run scaled variants of the five BASELINE.json configs end-to-end and
+record acc@round curves (results/<name>.jsonl + stdout summary).
+
+BASELINE.md asks for "CIFAR-10 acc@round" evidence on every benchmark
+config family.  Full-scale runs (BERT-base, ViT-B/16, 3400 clients, 100
+rounds) don't fit a single v5e chip's time budget, so each variant keeps
+the STRATEGY, MODEL FAMILY, PARTITION and round structure of its config and
+scales width/depth/clients/rounds down; the point is end-to-end learning
+curves through the real engine, not leaderboard numbers.  Data is the
+registry's synthetic stand-in (class-prototype structure, genuinely
+learnable; data/synthetic.py) unless real corpora are on disk.
+
+    python scripts/run_baseline_configs.py [--out results] [--only NAME]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def scaled_variants():
+    """name -> (scaled ExperimentConfig, note)."""
+    from colearn_federated_learning_tpu.utils.config import get_config
+
+    out = {}
+
+    c = get_config("mnist_mlp_fedavg")
+    c = c.replace(
+        data=dataclasses.replace(c.data, max_examples_per_client=512),
+        fed=dataclasses.replace(c.fed, rounds=20),
+    )
+    out["mnist_mlp_fedavg"] = (c, "full config; 512 examples/client cap")
+
+    c = get_config("cifar10_cnn_fedavg")
+    c = c.replace(
+        data=dataclasses.replace(c.data, max_examples_per_client=256),
+        fed=dataclasses.replace(c.fed, rounds=50),
+    )
+    out["cifar10_cnn_fedavg"] = (c, "full model; 50 rounds, 256 ex/client")
+
+    c = get_config("cifar100_resnet18_fedprox")
+    c = c.replace(
+        data=dataclasses.replace(c.data, max_examples_per_client=128),
+        fed=dataclasses.replace(c.fed, rounds=30),
+    )
+    out["cifar100_resnet18_fedprox"] = (c, "full ResNet-18; 30 rounds")
+
+    c = get_config("agnews_bert_fedavg")
+    c = c.replace(
+        model=dataclasses.replace(c.model, width=256, depth=4, num_heads=8),
+        data=dataclasses.replace(c.data, max_examples_per_client=256),
+        fed=dataclasses.replace(c.fed, rounds=20, lr=1e-4),
+    )
+    out["agnews_bert_fedavg"] = (
+        c, "BERT scaled 768x12 -> 256x4 (single-chip budget); lr 1e-4")
+
+    c = get_config("femnist_vit_cross_silo")
+    c = c.replace(
+        model=dataclasses.replace(c.model, width=192, depth=4, num_heads=3,
+                                  patch_size=7),
+        data=dataclasses.replace(c.data, num_clients=340,
+                                 max_examples_per_client=64),
+        fed=dataclasses.replace(c.fed, rounds=20, cohort_size=32),
+    )
+    out["femnist_vit_cross_silo"] = (
+        c, "ViT scaled B/16 -> tiny/7, 3400 -> 340 clients, cohort 32")
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="results")
+    p.add_argument("--only", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+
+    os.makedirs(args.out, exist_ok=True)
+    dev = jax.devices()[0]
+    summary = []
+    for name, (cfg, note) in scaled_variants().items():
+        if args.only and name != args.only:
+            continue
+        print(f"[{name}] {note}", file=sys.stderr)
+        t0 = time.perf_counter()
+        learner = FederatedLearner.from_config(cfg)
+        path = os.path.join(args.out, f"{name}.jsonl")
+        with open(path, "w") as f:
+            meta = {"config": name, "note": note,
+                    "device": dev.device_kind, "platform": dev.platform,
+                    "num_clients": learner.num_clients,
+                    "cohort": learner.cohort_size,
+                    "local_steps": learner.num_steps,
+                    "rounds": cfg.fed.rounds}
+            f.write(json.dumps(meta) + "\n")
+
+            def log(rec):
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                if "eval_acc" in rec:
+                    print(f"[{name}] round {rec['round']:3d} "
+                          f"loss {rec['train_loss']:.4f} "
+                          f"acc {rec['eval_acc']:.4f}", file=sys.stderr)
+
+            hist = learner.fit(log_fn=log)
+        wall = time.perf_counter() - t0
+        accs = [r.get("eval_acc") for r in hist if "eval_acc" in r]
+        summary.append({
+            "config": name,
+            "rounds": len(hist),
+            "final_acc": round(accs[-1], 4) if accs else None,
+            "best_acc": round(max(accs), 4) if accs else None,
+            "first_acc": round(accs[0], 4) if accs else None,
+            "wall_s": round(wall, 1),
+            "curve": path,
+        })
+        print(json.dumps(summary[-1]), file=sys.stderr)
+    print(json.dumps(summary, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -111,3 +111,27 @@ def test_all_stragglers_round_is_noop_under_secure_agg():
     after = jax.tree.map(np.asarray, learner.server_state.params)
     for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
         np.testing.assert_array_equal(a, b)
+
+
+def test_thousand_client_build_runs_a_round():
+    """North-star scale on the client axis (BASELINE.json: 1000-client
+    FedAvg): the vmap engine must build and run a cohort-64 round with
+    1000 resident clients.  Tiny model/shard keeps CI fast — the point is
+    the client-axis shapes, not the FLOPs."""
+    from colearn_federated_learning_tpu.utils.config import (
+        DataConfig, ExperimentConfig, FedConfig, ModelConfig, RunConfig,
+    )
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=1000,
+                        partition="iid", max_examples_per_client=8),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=8, depth=1),
+        fed=FedConfig(strategy="fedavg", rounds=1, cohort_size=64,
+                      local_steps=1, batch_size=4, lr=0.05, momentum=0.9),
+        run=RunConfig(name="thousand", backend="cpu"),
+    )
+    learner = FederatedLearner(cfg)
+    assert learner.num_clients == 1000 and learner.cohort_size == 64
+    rec = learner.run_round()
+    assert rec["completed"] == 64
+    assert np.isfinite(rec["train_loss"])

@@ -104,3 +104,63 @@ def test_bert_model_flash_matches_dense():
     yf = flash.apply({"params": params}, ids, train=False)
     np.testing.assert_allclose(np.asarray(yd), np.asarray(yf),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("L,block", [(40, 16), (32, 128)])
+def test_flash_backward_padded_blocks_match_dense(L, block):
+    """The Pallas backward must handle block padding exactly: odd L forces
+    padded q/k rows through both bwd kernels."""
+    q, k, v, mask = _rand(jax.random.PRNGKey(7), B=2, L=L, H=2, D=8)
+
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, mask, block_q=block, block_k=block) ** 2
+        ), argnums=(0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(
+        lambda q, k, v: jnp.sum(dense_attention(q, k, v, mask) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_backward_causal_matches_dense():
+    q, k, v, _ = _rand(jax.random.PRNGKey(8), B=1, L=32, H=2, D=8)
+
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=8, block_k=8) ** 2
+        ), argnums=(0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(
+        lambda q, k, v: jnp.sum(dense_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_backward_fully_masked_rows_zero_grad():
+    """Batch 0 has every key masked: its dq must be exactly zero and dk/dv
+    must receive no contribution from it."""
+    q, k, v, _ = _rand(jax.random.PRNGKey(9), B=2, L=16, H=1, D=4)
+    mask = jnp.zeros((2, 16), bool).at[1].set(True)
+
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, mask, block_q=8, block_k=8) ** 2
+        ), argnums=(0, 1, 2),
+    )(q, k, v)
+    assert np.allclose(np.asarray(gf[0])[0], 0.0)
+    assert np.allclose(np.asarray(gf[1])[0], 0.0)
+    assert np.allclose(np.asarray(gf[2])[0], 0.0)
+    gd = jax.grad(
+        lambda q, k, v: jnp.sum(dense_attention(q, k, v, mask) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
