@@ -53,6 +53,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dp-delta", type=float, default=None,
                    help="δ at which the RDP accountant reports ε")
     p.add_argument("--secure-agg", action="store_true", default=None)
+    p.add_argument("--secure-agg-neighbors", type=int, default=None,
+                   help="k-regular random-ring masking (0 = all pairs)")
     p.add_argument("--compress", default=None, choices=["none", "int8"],
                    help="update compression on the wire/file planes")
     p.add_argument("--straggler-prob", type=float, default=None)
@@ -70,7 +72,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "batch_size", "lr", "momentum", "local_optimizer", "strategy",
              "prox_mu", "dp_clip", "dp_noise_multiplier", "dp_delta",
-             "secure_agg", "straggler_prob", "compress"}
+             "secure_agg", "secure_agg_neighbors", "straggler_prob",
+             "compress"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}

@@ -220,6 +220,13 @@ class FederatedLearner:
 
         # --- local trainer -------------------------------------------
         self.scaffold = c.fed.strategy == "scaffold"
+        if c.fed.secure_agg and c.fed.secure_agg_neighbors and (
+            c.fed.secure_agg_neighbors % 2 or c.fed.secure_agg_neighbors < 2
+        ):
+            raise ValueError(
+                "secure_agg_neighbors must be an even integer >= 2, got "
+                f"{c.fed.secure_agg_neighbors}"
+            )
         if self.scaffold and (c.fed.secure_agg or c.fed.dp_clip > 0.0):
             raise ValueError(
                 "scaffold is incompatible with secure_agg/dp hooks: the "
@@ -389,9 +396,17 @@ class FederatedLearner:
             # GLOBAL ids, so cancellation holds across devices too (the
             # final sum is the psum over the mesh).
             wdeltas = jax.vmap(lambda d, w: pytrees.tree_scale(d, w))(deltas, weights)
+            # The per-round pairing graph (ring permutation or complete
+            # graph) is computed ONCE here, not per vmap lane — each lane
+            # then does only O(partners) PRG work.
+            partners = sa_lib.partner_table(
+                key, global_ids, mask_cohort_ids, round_idx,
+                neighbors=c.secure_agg_neighbors,
+            )
             masked = jax.vmap(
-                lambda d, i: sa_lib.mask_update(d, key, i, mask_cohort_ids, round_idx)
-            )(wdeltas, global_ids)
+                lambda d, i, prt: sa_lib.mask_update(d, key, i, prt,
+                                                     round_idx)
+            )(wdeltas, global_ids, partners)
             wsum = jax.tree.map(lambda l: jnp.sum(l, axis=0), masked)
         else:
             wsum = pytrees.tree_weighted_sum(deltas, weights)

@@ -12,9 +12,20 @@ This is the honest-but-curious core of the protocol (no dropout-recovery
 secret sharing); it demonstrates the masking hook the BASELINE north_star
 requires.  Both members of a pair expand bit-identical float32 streams, so
 cancellation is exact up to float32 summation rounding (residual ~1e-7·std
-per element — negligible against typical 1e-3-scale deltas).  Cost is
-O(cohort² · params) PRG work — fine for the cross-device cohorts (≤ a few
-hundred) it is meant for.
+per element — negligible against typical 1e-3-scale deltas).
+
+Two pairing graphs:
+
+- ``neighbors=0`` (default): the complete graph — every pair shares a
+  mask.  O(cohort² · params) PRG work; fine up to ~a-few-dozen cohorts.
+- ``neighbors=k``: a k-regular RANDOM RING — cohort members are permuted
+  by a per-round PRG (everyone derives the identical permutation from the
+  shared experiment key), and each client pairs with its k nearest ring
+  neighbors.  O(cohort · k · params) PRG work, so the flagship cohort=256
+  configs stop paying a 256×-per-client masking bill; unmasking one
+  client's update requires its k ring neighbors to collude (the
+  random-graph construction of Bell et al. 2020, PAPERS.md — pattern
+  only).
 """
 
 from __future__ import annotations
@@ -38,27 +49,82 @@ def _sample_tree(template, key: jax.Array, std: float = 1.0):
     return jax.tree.unflatten(treedef, out)
 
 
-def pairwise_mask(template, base_key: jax.Array, client_id, cohort_ids,
+def ring_partner_table(base_key: jax.Array, member_ids, cohort_ids, round_idx,
+                       neighbors: int):
+    """Partner table for the per-round random ring, computed ONCE per round.
+
+    All cohort members derive the IDENTICAL permutation (uniform scores
+    keyed on (experiment key, round, member id) and an argsort), so the
+    ring — and therefore every pair — is agreed without communication.
+
+    ``member_ids``: (M,) the members to build rows for (a device's local
+    cohort slice on a mesh); ``cohort_ids``: (C,) the full round cohort.
+    Returns ``(M, neighbors)`` partner ids — exactly ``neighbors`` per
+    member — or None when the cohort is too small for a ``neighbors``-
+    regular ring without double-counting a pair (C <= neighbors + 1;
+    callers fall back to the complete graph, which is CHEAPER there).
+
+    ``neighbors`` must be even (ring offsets come in ± pairs): an odd
+    degree cannot be realized and silently rounding would misstate the
+    collusion threshold the degree promises.
+    """
+    if neighbors % 2 or neighbors < 2:
+        raise ValueError(
+            f"secure_agg_neighbors must be an even integer >= 2, got "
+            f"{neighbors} (ring partners come in +/- offset pairs)"
+        )
+    C = cohort_ids.shape[0]
+    k2 = neighbors // 2
+    if k2 > (C - 1) // 2:
+        return None                    # complete graph is smaller anyway
+    rkey = prng.sampling_key(prng.mask_ring_key(base_key), round_idx)
+    scores = jax.vmap(
+        lambda i: jax.random.uniform(jax.random.fold_in(rkey, i))
+    )(cohort_ids)
+    ring = cohort_ids[jnp.argsort(scores)]
+    pos = jnp.argmax(ring[None, :] == member_ids[:, None], axis=1)  # (M,)
+    offs = jnp.concatenate([jnp.arange(1, k2 + 1), -jnp.arange(1, k2 + 1)])
+    return ring[(pos[:, None] + offs[None, :]) % C]                 # (M, 2k2)
+
+
+def pairwise_mask(template, base_key: jax.Array, client_id, partner_ids,
                   round_idx, std: float = 1.0):
     """The mask client ``client_id`` adds to its (pre-weighted) update.
 
-    ``cohort_ids``: (C,) int32 ids of all cohort members this round
-    (including ``client_id`` itself — the self-pair contributes sign 0).
+    ``partner_ids``: (P,) ids this client shares pair keys with — the whole
+    cohort for complete-graph masking (the self-pair contributes sign 0),
+    or this client's row of :func:`ring_partner_table`.
     """
     zeros = pytrees.tree_zeros_like(template)
 
     def body(j, acc):
-        other = cohort_ids[j]
+        other = partner_ids[j]
         k = prng.pair_mask_key(base_key, client_id, other, round_idx)
         sign = jnp.sign(other - client_id).astype(jnp.float32)
         noise = _sample_tree(template, k, std)
         return jax.tree.map(lambda a, n: a + sign.astype(n.dtype) * n, acc, noise)
 
-    return jax.lax.fori_loop(0, cohort_ids.shape[0], body, zeros)
+    return jax.lax.fori_loop(0, partner_ids.shape[0], body, zeros)
 
 
-def mask_update(update, base_key: jax.Array, client_id, cohort_ids, round_idx,
+def mask_update(update, base_key: jax.Array, client_id, partner_ids, round_idx,
                 std: float = 1.0):
     """Add this client's pairwise mask to its update (before aggregation)."""
-    mask = pairwise_mask(update, base_key, client_id, cohort_ids, round_idx, std)
+    mask = pairwise_mask(update, base_key, client_id, partner_ids, round_idx,
+                         std)
     return pytrees.tree_add(update, mask)
+
+
+def partner_table(base_key: jax.Array, member_ids, cohort_ids, round_idx,
+                  neighbors: int = 0):
+    """(M, P) partner ids per member: the random ring when ``neighbors`` is
+    set and the cohort supports it, else every member paired with the full
+    cohort (complete graph)."""
+    if neighbors > 0:
+        table = ring_partner_table(base_key, member_ids, cohort_ids,
+                                   round_idx, neighbors)
+        if table is not None:
+            return table
+    return jnp.broadcast_to(
+        cohort_ids[None, :], (member_ids.shape[0], cohort_ids.shape[0])
+    )

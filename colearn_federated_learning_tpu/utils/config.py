@@ -64,6 +64,7 @@ class FedConfig:
     dp_noise_multiplier: float = 0.0  # Gaussian sigma = mult * clip
     dp_delta: float = 1e-5            # δ at which the accountant reports ε
     secure_agg: bool = False
+    secure_agg_neighbors: int = 0     # 0 = all-pairs masks; k = random ring
     # Update compression on the wire/file planes (fed/compression.py).
     compress: str = "none"            # none | int8
 

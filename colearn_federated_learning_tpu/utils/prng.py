@@ -25,6 +25,7 @@ _TAG_MASK = 0x4
 _TAG_STRAGGLER = 0x5
 _TAG_INIT = 0x6
 _TAG_DATA = 0x7
+_TAG_MASK_RING = 0x8
 
 
 def experiment_key(seed: int) -> jax.Array:
@@ -83,3 +84,9 @@ def pair_mask_key(key: jax.Array, client_a, client_b, round_idx) -> jax.Array:
 def straggler_key(key: jax.Array, round_idx) -> jax.Array:
     """Key for simulated straggler step budgets in one round."""
     return _derive(key, _TAG_STRAGGLER, round_idx)
+
+
+def mask_ring_key(key: jax.Array) -> jax.Array:
+    """Base key for the secure-agg random-ring permutation (the per-round
+    ring is derived from this with sampling_key, privacy/secure_agg.py)."""
+    return _derive(key, _TAG_MASK_RING)

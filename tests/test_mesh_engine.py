@@ -53,3 +53,27 @@ def test_sharded_privacy_path_runs(mesh8):
     assert np.isfinite(hist[-1]["train_loss"])
     # Ghost clients (counts==0) must be excluded from uniform weighting.
     assert hist[-1]["total_weight"] <= 10
+
+
+def test_sharded_partial_cohort_is_device_stratified(mesh8):
+    """Partial cohorts on a mesh are sampled PER DEVICE (stratified): each
+    device contributes exactly cohort/D of its own resident clients, and —
+    because real clients are interleaved across devices — every sampled
+    slot is a real client whenever each device holds >= cohort/D reals.
+    This is a deliberate semantic difference from the vmap engine's global
+    without-replacement sample (no cross-device data movement); this test
+    pins the contract."""
+    cfg = tiny_config(rounds=3, cohort_size=8)
+    cfg = dataclasses.replace(
+        cfg, data=dataclasses.replace(cfg.data, num_clients=24)
+    )
+    learner = FederatedLearner(cfg, mesh=mesh8)
+    assert learner.cohort_per_device == 1    # 8 slots over 8 devices
+    hist = learner.fit(rounds=3)
+    for rec in hist:
+        # all 8 sampled slots are real clients -> all complete, and the
+        # total weight is the sum of exactly 8 real shard counts
+        assert rec["completed"] == 8
+        assert rec["total_weight"] > 0
+    _, acc = learner.evaluate()
+    assert np.isfinite(acc)

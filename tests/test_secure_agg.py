@@ -1,0 +1,120 @@
+"""Secure-aggregation masking: exact cancellation for the complete graph
+and the k-regular random ring, ring symmetry, and engine integration at a
+cohort size where all-pairs masking would be the dominant cost."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.privacy import secure_agg as sa
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def _cohort(C, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.choice(1000, size=C, replace=False).astype(np.int32))
+
+
+@pytest.mark.parametrize("C,neighbors", [(6, 0), (6, 2), (7, 4), (16, 4),
+                                         (5, 8), (2, 4)])
+def test_masks_cancel_in_the_sum(C, neighbors):
+    """Summed over the cohort, the masks cancel to float32 round-off —
+    complete graph and random ring alike (incl. cohorts too small for the
+    requested degree, which fall back to the complete graph)."""
+    template = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,))}
+    key = jax.random.PRNGKey(3)
+    ids = _cohort(C)
+    partners = sa.partner_table(key, ids, ids, 5, neighbors=neighbors)
+    masks = jax.vmap(
+        lambda i, prt: sa.pairwise_mask(template, key, i, prt, 5)
+    )(ids, partners)
+    for leaf in jax.tree.leaves(masks):
+        per_mask_scale = np.abs(np.asarray(leaf)).mean()
+        assert per_mask_scale > 0.1          # masks are real noise
+        total = np.asarray(leaf.sum(axis=0))
+        np.testing.assert_allclose(total, 0.0, atol=1e-4)
+
+
+def test_ring_partnership_is_symmetric_and_exactly_k():
+    """i lists j as a partner iff j lists i — the property cancellation
+    rests on — and every member gets EXACTLY the configured degree."""
+    key = jax.random.PRNGKey(0)
+    ids = _cohort(9)
+    table = np.asarray(sa.ring_partner_table(key, ids, ids, 2, neighbors=4))
+    partner_sets = {
+        int(i): set(row.tolist()) for i, row in zip(np.asarray(ids), table)
+    }
+    for i, partners in partner_sets.items():
+        assert len(partners) == 4            # k-regular, no duplicates
+        assert i not in partners
+        for j in partners:
+            assert i in partner_sets[j]
+
+
+def test_ring_refuses_odd_degree_and_tiny_cohorts():
+    key = jax.random.PRNGKey(0)
+    ids = _cohort(8)
+    with pytest.raises(ValueError, match="even"):
+        sa.ring_partner_table(key, ids, ids, 0, neighbors=3)
+    # cohort too small for a 4-regular ring -> signalled, caller falls back
+    assert sa.ring_partner_table(key, _cohort(4), _cohort(4), 0,
+                                 neighbors=4) is None
+    # engine-level validation of the config knob
+    cfg = _cfg(secure_agg=True, secure_agg_neighbors=3)
+    with pytest.raises(ValueError, match="even"):
+        FederatedLearner(cfg)
+
+
+def test_ring_changes_per_round():
+    key = jax.random.PRNGKey(0)
+    ids = _cohort(16)
+    rings = {
+        r: tuple(np.asarray(
+            sa.ring_partner_table(key, ids, ids, r, neighbors=2))[0].tolist())
+        for r in range(6)
+    }
+    assert len(set(rings.values())) > 1      # permutation is per-round
+
+
+def _cfg(**fed_kw):
+    fed = dict(strategy="fedavg", rounds=6, cohort_size=16, local_steps=2,
+               batch_size=16, lr=0.1, momentum=0.9)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=32,
+                        partition="iid", max_examples_per_client=32),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=16, depth=1),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="ring_sa", backend="cpu"),
+    )
+
+
+def test_engine_ring_masking_learns():
+    """cohort=16 with k=4 ring masks: the aggregate is unchanged by the
+    masks (loss finite, accuracy rises) at 4/15th of the all-pairs PRG
+    work."""
+    cfg = _cfg(secure_agg=True, secure_agg_neighbors=4)
+    learner = FederatedLearner(cfg)
+    learner.fit(rounds=6)
+    loss, acc = learner.evaluate()
+    assert np.isfinite(loss)
+    assert acc > 0.5
+
+    # Ring masks and all-pairs masks both cancel, so the two runs see the
+    # same aggregates (uniform weighting applies under SA either way).
+    allpairs = FederatedLearner(cfg.replace(
+        fed=dataclasses.replace(cfg.fed, secure_agg_neighbors=0)))
+    allpairs.fit(rounds=6)
+    loss_ap, acc_ap = allpairs.evaluate()
+    np.testing.assert_allclose(loss, loss_ap, rtol=1e-3)
+    np.testing.assert_allclose(acc, acc_ap, rtol=1e-3)
