@@ -87,7 +87,7 @@ def test_ring_changes_per_round():
 
 
 def _cfg(**fed_kw):
-    fed = dict(strategy="fedavg", rounds=6, cohort_size=16, local_steps=2,
+    fed = dict(strategy="fedavg", rounds=4, cohort_size=16, local_steps=2,
                batch_size=16, lr=0.1, momentum=0.9)
     fed.update(fed_kw)
     return ExperimentConfig(
@@ -105,7 +105,7 @@ def test_engine_ring_masking_learns():
     work."""
     cfg = _cfg(secure_agg=True, secure_agg_neighbors=4)
     learner = FederatedLearner(cfg)
-    learner.fit(rounds=6)
+    learner.fit()                       # config.fed.rounds
     loss, acc = learner.evaluate()
     assert np.isfinite(loss)
     assert acc > 0.5
@@ -114,7 +114,7 @@ def test_engine_ring_masking_learns():
     # same aggregates (uniform weighting applies under SA either way).
     allpairs = FederatedLearner(cfg.replace(
         fed=dataclasses.replace(cfg.fed, secure_agg_neighbors=0)))
-    allpairs.fit(rounds=6)
+    allpairs.fit()
     loss_ap, acc_ap = allpairs.evaluate()
     np.testing.assert_allclose(loss, loss_ap, rtol=1e-3)
     np.testing.assert_allclose(acc, acc_ap, rtol=1e-3)

@@ -26,8 +26,10 @@ from colearn_federated_learning_tpu.utils.config import (
     RunConfig,
 )
 
-BERT_CFG = ModelConfig(name="bert", num_classes=4, width=32, depth=2,
-                       num_heads=4, seq_len=32, vocab_size=200)
+# Small on purpose: these tests pay 8-device shard_map compiles on one
+# CPU core; depth 2 keeps inter-block coverage, width/heads are minimal.
+BERT_CFG = ModelConfig(name="bert", num_classes=4, width=16, depth=2,
+                       num_heads=2, seq_len=32, vocab_size=200)
 
 
 def _models_and_params():
@@ -74,13 +76,13 @@ def test_sp_grads_match_dense(cpu_devices):
 def _sp_exp_config(attn_impl="ring"):
     return ExperimentConfig(
         data=DataConfig(dataset="agnews_tiny", num_clients=8, partition="iid",
-                        max_examples_per_client=64),
+                        max_examples_per_client=16),
         model=dataclasses.replace(
             BERT_CFG, seq_len=64, vocab_size=2000, attn_impl=attn_impl),
         # Full participation (cohort = all clients): mesh and single-device
         # paths then train the SAME cohort, so results must agree.
         fed=FedConfig(strategy="fedavg", rounds=2, cohort_size=0,
-                      local_steps=2, batch_size=8, lr=0.1, momentum=0.9),
+                      local_steps=1, batch_size=4, lr=0.1, momentum=0.9),
         run=RunConfig(name="sp_test", backend="cpu"),
     )
 
