@@ -55,7 +55,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--secure-agg", action="store_true", default=None)
     p.add_argument("--secure-agg-neighbors", type=int, default=None,
                    help="k-regular random-ring masking (0 = all pairs)")
-    p.add_argument("--compress", default=None, choices=["none", "int8"],
+    p.add_argument("--compress", default=None,
+                   choices=["none", "int8", "topk"],
                    help="update compression on the wire/file planes")
     p.add_argument("--straggler-prob", type=float, default=None)
     p.add_argument("--eval-every", type=int, default=None)

@@ -201,7 +201,8 @@ class FederatedCoordinator:
             if int(meta.get("round", r)) != r:       # stale update: refuse
                 dropped.append(str(meta.get("client_id")))
                 continue
-            delta = compression.decompress_delta(delta, meta)
+            delta = compression.decompress_delta(delta, meta,
+                                                 shapes=params_np)
             w = float(meta.get("weight", 1.0))
             contrib = pytrees.tree_scale(jax.tree.map(np.asarray, delta), w)
             wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)

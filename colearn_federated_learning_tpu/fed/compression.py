@@ -8,6 +8,13 @@ DELTAS (not params — deltas are small-range and quantize well):
   shrink ~4x, each leaf replaced by ``{"q": int8[...], "s": scale}``.
   Quantization error per round is O(scale/127); FedAvg's averaging
   further shrinks it by the cohort size.
+- ``topk``: per-leaf magnitude sparsification — only the largest
+  ``TOPK_FRACTION`` of entries survive, shipped as ``{"i": int32 indices,
+  "v": float32 values, "n": size}`` (8 bytes/kept entry → ~10x at the
+  default 5% density).  The standard sparsification baseline (Aji &
+  Heafield 2017 pattern, PAPERS.md — pattern only); biased, but FedAvg's
+  cohort averaging recovers most of the signal and the wire planes are
+  where the bytes matter.
 - ``none``: passthrough.
 
 Only the WIRE/FILE planes compress (comm/worker.py replies, offline update
@@ -21,49 +28,94 @@ from typing import Any
 
 import numpy as np
 
-SCHEMES = ("none", "int8")
+SCHEMES = ("none", "int8", "topk")
 _Q, _S = "q", "s"
+_I, _V, _N = "i", "v", "n"
+TOPK_FRACTION = 0.05
 
 
 def _is_qleaf(node: Any) -> bool:
     return isinstance(node, dict) and set(node) == {_Q, _S}
 
 
+def _is_kleaf(node: Any) -> bool:
+    return isinstance(node, dict) and set(node) == {_I, _V, _N}
+
+
 def compress_delta(delta: Any, scheme: str) -> tuple[Any, dict]:
     """Returns (wire_tree, meta_fields) — a nested dict the CLW1/npz
     codecs serialize directly."""
-    if scheme == "none":
-        return delta, {"compress": "none"}
-    if scheme != "int8":
-        raise ValueError(f"unknown compression {scheme!r} (use {SCHEMES})")
-
-    def q(leaf):
-        arr = np.asarray(leaf, dtype=np.float32)
-        scale = float(np.max(np.abs(arr))) / 127.0 if arr.size else 0.0
-        if scale == 0.0:
-            qa = np.zeros(arr.shape, np.int8)
-        else:
-            qa = np.clip(np.rint(arr / scale), -127, 127).astype(np.int8)
-        return {_Q: qa, _S: np.float32(scale)}
-
     import jax
 
-    return jax.tree.map(q, delta), {"compress": "int8"}
+    if scheme == "none":
+        return delta, {"compress": "none"}
+    if scheme == "int8":
+        def q(leaf):
+            arr = np.asarray(leaf, dtype=np.float32)
+            scale = float(np.max(np.abs(arr))) / 127.0 if arr.size else 0.0
+            if scale == 0.0:
+                qa = np.zeros(arr.shape, np.int8)
+            else:
+                qa = np.clip(np.rint(arr / scale), -127, 127).astype(np.int8)
+            return {_Q: qa, _S: np.float32(scale)}
+
+        return jax.tree.map(q, delta), {"compress": "int8"}
+    if scheme == "topk":
+        def k_of(leaf):
+            flat = np.asarray(leaf, np.float32).ravel()
+            # Keep at least one entry so tiny biases/scalars survive.
+            k = max(1, int(np.ceil(flat.size * TOPK_FRACTION)))
+            idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
+            idx = np.sort(idx).astype(np.int32)
+            return {_I: idx, _V: flat[idx], _N: np.int64(flat.size)}
+
+        return jax.tree.map(k_of, delta), {"compress": "topk"}
+    raise ValueError(f"unknown compression {scheme!r} (use {SCHEMES})")
 
 
-def decompress_delta(wire_tree: Any, meta: dict) -> Any:
-    """Inverse of :func:`compress_delta`; rebuilds the float delta."""
+def decompress_delta(wire_tree: Any, meta: dict, shapes: Any = None) -> Any:
+    """Inverse of :func:`compress_delta`; rebuilds the float delta.
+
+    ``shapes``: matching pytree of ARRAYS (e.g. the global params) —
+    required to un-flatten ``topk`` leaves back to their original shapes;
+    int8 leaves carry their shape themselves.
+    """
     scheme = meta.get("compress", "none")
     if scheme == "none":
         return wire_tree
-    if scheme != "int8":
-        raise ValueError(f"unknown compression {scheme!r}")
+    if scheme == "int8":
+        def walk(node):
+            if _is_qleaf(node):
+                return np.asarray(node[_Q], np.float32) * np.float32(node[_S])
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            raise TypeError(
+                f"unexpected node {type(node).__name__} in int8 tree"
+            )
 
-    def walk(node):
-        if _is_qleaf(node):
-            return np.asarray(node[_Q], np.float32) * np.float32(node[_S])
-        if isinstance(node, dict):
-            return {k: walk(v) for k, v in node.items()}
-        raise TypeError(f"unexpected node {type(node).__name__} in int8 tree")
+        return walk(wire_tree)
+    if scheme == "topk":
+        import jax
 
-    return walk(wire_tree)
+        if shapes is None:
+            raise ValueError("topk decompression needs the `shapes` pytree")
+
+        def unk(node, ref):
+            if not _is_kleaf(node):
+                raise TypeError(
+                    f"unexpected node {type(node).__name__} in topk tree"
+                )
+            flat = np.zeros(int(node[_N]), np.float32)
+            flat[np.asarray(node[_I])] = np.asarray(node[_V], np.float32)
+            return flat.reshape(np.asarray(ref).shape)
+
+        # Walk the REFERENCE tree's structure and stop at ITS leaf
+        # positions (flatten_up_to), so the kleaf dicts — and any container
+        # types compress_delta's tree.map recursed through — round-trip.
+        treedef = jax.tree.structure(shapes)
+        refs = jax.tree.leaves(shapes)
+        nodes = treedef.flatten_up_to(wire_tree)
+        return jax.tree.unflatten(
+            treedef, [unk(n, r) for n, r in zip(nodes, refs)]
+        )
+    raise ValueError(f"unknown compression {scheme!r}")

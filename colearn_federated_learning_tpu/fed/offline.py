@@ -120,7 +120,7 @@ def aggregate_updates(
                 f"stale update {p}: computed at round {umeta['round']}, "
                 f"global model is at round {round_idx}"
             )
-        delta = compression.decompress_delta(delta, umeta)
+        delta = compression.decompress_delta(delta, umeta, shapes=params)
         w = float(umeta.get("weight", 1.0))
         contrib = pytrees.tree_scale(delta, w)
         wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)

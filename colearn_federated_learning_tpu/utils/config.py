@@ -66,7 +66,7 @@ class FedConfig:
     secure_agg: bool = False
     secure_agg_neighbors: int = 0     # 0 = all-pairs masks; k = random ring
     # Update compression on the wire/file planes (fed/compression.py).
-    compress: str = "none"            # none | int8
+    compress: str = "none"            # none | int8 | topk
 
 
 @dataclasses.dataclass(frozen=True)

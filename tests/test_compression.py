@@ -48,7 +48,7 @@ def test_none_passthrough_and_unknown():
     wire, meta = compression.compress_delta(d, "none")
     assert wire is d and compression.decompress_delta(wire, meta) is d
     with pytest.raises(ValueError, match="unknown compression"):
-        compression.compress_delta(d, "topk")
+        compression.compress_delta(d, "gzip9")
 
 
 def test_offline_flow_with_int8(tmp_path):
@@ -85,3 +85,70 @@ def test_offline_flow_with_int8(tmp_path):
     b, _ = serialization.load_pytree_npz(g1b)
     for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_allclose(la, lb, atol=2e-3)
+
+
+def test_topk_roundtrip_keeps_largest_entries():
+    d = _delta()
+    wire, meta = compression.compress_delta(d, "topk")
+    assert meta["compress"] == "topk"
+    out = compression.decompress_delta(wire, meta, shapes=d)
+    for path in (("layer", "w"), ("layer", "b"), ("head", "w")):
+        a = d[path[0]][path[1]]
+        b = out[path[0]][path[1]]
+        assert b.shape == a.shape
+        k = max(1, int(np.ceil(a.size * compression.TOPK_FRACTION)))
+        kept = np.flatnonzero(b.ravel())
+        assert len(kept) <= k
+        # every kept value is exact, and they are the top magnitudes
+        np.testing.assert_array_equal(b.ravel()[kept], a.ravel()[kept])
+        thresh = np.sort(np.abs(a.ravel()))[-k] if a.any() else 0.0
+        assert (np.abs(a.ravel()[kept]) >= thresh - 1e-12).all()
+
+
+def test_topk_shrinks_wire_payload_and_needs_shapes():
+    d = _delta()
+    plain = serialization.pytree_to_bytes(d)
+    wire, meta = compression.compress_delta(d, "topk")
+    packed = serialization.pytree_to_bytes(wire, meta)
+    assert len(packed) < len(plain) * 0.2        # ~5% density + indices
+    with pytest.raises(ValueError, match="shapes"):
+        compression.decompress_delta(wire, meta)
+
+
+def test_offline_flow_with_topk(tmp_path):
+    """File federation end-to-end with sparse updates: init -> 2 client
+    updates -> aggregate -> eval stays finite and the model moves."""
+    from colearn_federated_learning_tpu.fed import offline
+    from colearn_federated_learning_tpu.utils.config import (
+        DataConfig, ExperimentConfig, FedConfig, ModelConfig, RunConfig,
+    )
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=2, partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=16, depth=1),
+        fed=FedConfig(strategy="fedavg", rounds=1, local_steps=2,
+                      batch_size=16, lr=0.1, momentum=0.9, compress="topk"),
+        run=RunConfig(name="topk_flow", backend="cpu"),
+    )
+    g0 = str(tmp_path / "g0.npz")
+    offline.init_global_model(cfg, g0)
+    ups = []
+    for cid in range(2):
+        up = str(tmp_path / f"u{cid}.npz")
+        offline.client_update(cfg, cid, g0, up)
+        ups.append(up)
+    g1 = str(tmp_path / "g1.npz")
+    stats = offline.aggregate_updates(cfg, g0, ups, g1)
+    assert stats["round"] == 1
+    rep = offline.evaluate_global(cfg, g1)
+    assert np.isfinite(rep["eval_loss"])
+
+
+def test_topk_roundtrips_list_containers():
+    d = {"layers": [np.arange(12, dtype=np.float32).reshape(3, 4),
+                    np.ones(5, np.float32)]}
+    wire, meta = compression.compress_delta(d, "topk")
+    out = compression.decompress_delta(wire, meta, shapes=d)
+    assert isinstance(out["layers"], list)
+    assert out["layers"][0].shape == (3, 4)
+    assert out["layers"][1].shape == (5,)
