@@ -101,14 +101,30 @@ class FederatedLearner:
         from colearn_federated_learning_tpu.parallel.mesh import make_mesh
 
         devices = _resolve_devices(config.run.backend)
+        r = config.run
+        if config.model.attn_impl == "ring" and r.tp_size > 1:
+            raise ValueError(
+                "from_config cannot auto-lay a 3-D (clients, seq, model) "
+                "mesh; build it with parallel.mesh.make_mesh and pass "
+                "mesh= explicitly"
+            )
         mesh = None
+        if r.tp_size > 1 and len(devices) < r.tp_size:
+            import warnings
+
+            warnings.warn(
+                f"tp_size={r.tp_size} needs at least that many devices, "
+                f"have {len(devices)}; running without tensor parallelism",
+                stacklevel=2,
+            )
         if len(devices) > 1:
             if config.model.attn_impl == "ring":
-                mesh = make_mesh(
-                    (config.run.mesh_axis, config.run.seq_axis), devices=devices
-                )
+                mesh = make_mesh((r.mesh_axis, r.seq_axis), devices=devices)
+            elif r.tp_size > 1 and len(devices) >= r.tp_size:
+                mesh = make_mesh((r.mesh_axis, r.tp_axis), (-1, r.tp_size),
+                                 devices=devices)
             else:
-                mesh = Mesh(np.array(devices), (config.run.mesh_axis,))
+                mesh = Mesh(np.array(devices), (r.mesh_axis,))
         return cls(config, dataset=dataset, mesh=mesh)
 
     def __init__(
@@ -122,11 +138,15 @@ class FederatedLearner:
         c = config
 
         # --- mesh axes ------------------------------------------------
-        # 1-D mesh: clients only.  2-D mesh (attn_impl="ring"): clients on
-        # the outer axis, each client's sequence dim sharded over the inner
-        # ``seq`` axis (sequence parallelism; parallel/ring.py).
+        # 1-D mesh: clients only.  2-D (attn_impl="ring"): + an inner ``seq``
+        # axis (sequence parallelism; parallel/ring.py).  A ``model`` axis
+        # (parallel/tp.py) adds tensor/expert parallelism: it is left to the
+        # AUTOMATIC partitioner (shard_map axis_names excludes it), params
+        # are sharded over it by the TP rules, and XLA inserts the TP
+        # collectives inside each client's local step.
         self.client_axis = c.run.mesh_axis
         self.seq_axis = c.run.seq_axis
+        self.tp_axis = c.run.tp_axis
         if mesh is not None:
             if self.client_axis not in mesh.shape:
                 raise ValueError(
@@ -135,12 +155,16 @@ class FederatedLearner:
                 )
             self.clients_size = mesh.shape[self.client_axis]
             self.seq_size = mesh.shape.get(self.seq_axis, 1)
-            extra = set(mesh.shape) - {self.client_axis, self.seq_axis}
+            self.tp_size = mesh.shape.get(self.tp_axis, 1)
+            extra = set(mesh.shape) - {
+                self.client_axis, self.seq_axis, self.tp_axis
+            }
             if extra:
                 raise ValueError(f"unsupported mesh axes {sorted(extra)}")
         else:
             self.clients_size = 1
             self.seq_size = 1
+            self.tp_size = 1
         self.sp = self.seq_size > 1
         if self.sp and c.model.attn_impl != "ring":
             raise ValueError(
@@ -216,6 +240,14 @@ class FederatedLearner:
         example_x = jnp.asarray(shards.x[0, : c.fed.batch_size])
         ikey = prng.init_key(prng.experiment_key(c.run.seed))
         self.params = model_registry.init_params(self.eval_model, example_x, ikey)
+        if self.tp_size > 1:
+            # Tensor parallelism: shard the wide param dims over the model
+            # axis (parallel/tp.py rules); ``init_server_state``'s
+            # zeros_like leaves inherit the shardings, so the whole server
+            # state lives TP-sharded from the start.
+            from colearn_federated_learning_tpu.parallel import tp as tp_lib
+
+            self.params = tp_lib.shard_params(self.params, mesh, self.tp_axis)
         self.server_state = strategies.init_server_state(self.params, c.fed)
 
         # --- local trainer -------------------------------------------
@@ -232,6 +264,12 @@ class FederatedLearner:
                 "scaffold is incompatible with secure_agg/dp hooks: the "
                 "control-variate deltas are a second payload the masks and "
                 "noise calibration do not cover"
+            )
+        if self.scaffold and self.tp_size > 1:
+            raise ValueError(
+                "scaffold with a model (TP) axis is unsupported: the "
+                "host-resident variate store is unsharded and the per-round "
+                "gather/scatter would funnel TP shards through one host"
             )
         self.local_update, self.num_steps = setup_lib.local_trainer_for_config(
             c, self.model.apply, shards.capacity,
@@ -462,6 +500,16 @@ class FederatedLearner:
         }
         return new_state, metrics
 
+    def _manual_axes(self) -> frozenset:
+        """Mesh axes the round shard_map is MANUAL over: clients (+ seq
+        under SP).  A ``model`` (TP) axis stays out of the set, so the
+        automatic partitioner handles it — params arrive sharded over it
+        (parallel/tp.py) and XLA inserts the tensor-parallel collectives."""
+        axes = {self.client_axis}
+        if self.sp:
+            axes.add(self.seq_axis)
+        return frozenset(axes)
+
     def _donate_argnums(self) -> tuple[int, ...]:
         """Donate the consumed round state (server_state, cohort variate
         block) so XLA reuses their HBM in place — matters for big models.
@@ -566,6 +614,7 @@ class FederatedLearner:
             in_specs=(P(), P(), P(), x_spec, P(ax), P(ax), P(ax), sel_spec,
                       c_spec),
             out_specs=(P(), P(), c_spec),
+            axis_names=self._manual_axes(),
             check_vma=False,
         )
         return jax.jit(sharded, donate_argnums=self._donate_argnums())
@@ -776,6 +825,7 @@ class FederatedLearner:
             vmapped, mesh=self.mesh,
             in_specs=(P(), x_spec, P(ax), P(ax)),
             out_specs=(P(ax), P(ax)),
+            axis_names=self._manual_axes(),
             check_vma=False,
         ))
 

@@ -76,6 +76,8 @@ class RunConfig:
     backend: str = "auto"             # "auto" | "tpu" | "cpu"  (CLI --backend)
     mesh_axis: str = "clients"
     seq_axis: str = "seq"             # sequence-parallel axis (attn_impl="ring")
+    tp_axis: str = "model"            # tensor/expert-parallel axis (parallel/tp.py)
+    tp_size: int = 1                  # model-axis size for from_config meshes
     log_every: int = 1
     eval_every: int = 1
     checkpoint_dir: Optional[str] = None
