@@ -66,6 +66,19 @@ def make_optimizer(lr: float, momentum: float,
     raise ValueError(f"unknown local optimizer {name!r} (sgd|adam|adamw)")
 
 
+def _sown_aux_mean(intermediates) -> jnp.ndarray | None:
+    """Mean of all ``moe_aux`` values sown during apply (models/moe.py's
+    Switch load-balance loss, one per MoE layer); None when nothing sown."""
+    vals = [
+        leaf
+        for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates)
+        if any(getattr(p, "key", None) == "moe_aux" for p in path)
+    ]
+    if not vals:
+        return None
+    return sum(vals) / len(vals)
+
+
 def make_local_update(
     apply_fn: Callable,
     optimizer: optax.GradientTransformation,
@@ -76,6 +89,7 @@ def make_local_update(
     grad_sync_axes: tuple[str, ...] = (),
     scaffold: bool = False,
     lr: float = 0.0,
+    aux_loss_weight: float = 0.0,
 ) -> Callable:
     """Build ``local_update(global_params, x, y, count, key, step_budget)``.
 
@@ -100,8 +114,20 @@ def make_local_update(
     min_steps = max(1, int(num_steps * min_steps_fraction))
 
     def loss_fn(params, global_params, xb, yb):
-        logits = apply_fn({"params": params}, xb, train=True)
-        loss = losses.softmax_cross_entropy(logits, yb)
+        if aux_loss_weight > 0.0:
+            # MoE models sow their load-balance loss into "intermediates";
+            # running every model this way would be harmless (flax returns
+            # an empty dict) but the mutable round-trip is only paid when
+            # the config asks for it.
+            logits, updates = apply_fn(
+                {"params": params}, xb, train=True, mutable=["intermediates"]
+            )
+            aux = _sown_aux_mean(updates.get("intermediates", {}))
+            extra = aux_loss_weight * aux if aux is not None else 0.0
+        else:
+            logits = apply_fn({"params": params}, xb, train=True)
+            extra = 0.0
+        loss = losses.softmax_cross_entropy(logits, yb) + extra
         if prox_mu > 0.0:
             # FedProx: + μ/2 ‖w − w_global‖² (BASELINE config #3, μ=0.01).
             # Under SP its grads flow through the (replicated) params on

@@ -80,6 +80,7 @@ def local_trainer_for_config(
         )
     num_steps = num_steps_for_config(config, capacity)
     optimizer = local_lib.make_optimizer(c.lr, c.momentum, c.local_optimizer)
+    is_moe = config.model.name.startswith("moe")
     update_fn = local_lib.make_local_update(
         apply_fn,
         optimizer,
@@ -90,6 +91,7 @@ def local_trainer_for_config(
         grad_sync_axes=grad_sync_axes,
         scaffold=c.strategy == "scaffold",
         lr=c.lr,
+        aux_loss_weight=config.model.moe_aux_weight if is_moe else 0.0,
     )
     return update_fn, num_steps
 

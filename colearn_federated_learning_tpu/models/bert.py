@@ -27,6 +27,7 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "dense"
     attn_axis_name: Optional[str] = None
+    num_experts: int = 0              # > 0: MoE FFN (models/moe.py)
 
     @nn.compact
     def __call__(self, x, pad_mask):
@@ -36,9 +37,15 @@ class TransformerBlock(nn.Module):
             impl=self.attn_impl, axis_name=self.attn_axis_name,
         )(x, pad_mask)
         x = nn.LayerNorm(dtype=self.dtype)(x + attn)
-        h = nn.Dense(self.embed_dim * self.mlp_ratio, dtype=self.dtype)(x)
-        h = nn.gelu(h)
-        h = nn.Dense(self.embed_dim, dtype=self.dtype)(h)
+        if self.num_experts > 0:
+            from colearn_federated_learning_tpu.models.moe import MoEFfn
+
+            h = MoEFfn(self.embed_dim, self.num_experts,
+                       mlp_ratio=self.mlp_ratio, dtype=self.dtype)(x)
+        else:
+            h = nn.Dense(self.embed_dim * self.mlp_ratio, dtype=self.dtype)(x)
+            h = nn.gelu(h)
+            h = nn.Dense(self.embed_dim, dtype=self.dtype)(h)
         return nn.LayerNorm(dtype=self.dtype)(x + h)
 
 
@@ -52,6 +59,10 @@ class BertClassifier(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "dense"
     seq_axis_name: Optional[str] = None
+    # > 0 turns every other block (odd index; block 0 when depth == 1)
+    # into a mixture-of-experts block — the GShard interleaving, so deep
+    # models keep dense MLPs between MoE layers.
+    num_experts: int = 0
 
     @nn.compact
     def __call__(self, ids, train: bool = False):
@@ -78,10 +89,14 @@ class BertClassifier(nn.Module):
             pos_l = pos[:, :L]
         x = tok + pos_l.astype(self.dtype)
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        for _ in range(self.depth):
+        for i in range(self.depth):
+            moe_here = self.num_experts > 0 and (
+                i % 2 == 1 or self.depth == 1
+            )
             x = TransformerBlock(self.embed_dim, self.num_heads, dtype=self.dtype,
                                  attn_impl=self.attn_impl,
-                                 attn_axis_name=sp)(
+                                 attn_axis_name=sp,
+                                 num_experts=self.num_experts if moe_here else 0)(
                 x, pad_mask
             )
         # Masked mean pooling (no [CLS] convention in the synthetic corpus);

@@ -1,0 +1,112 @@
+"""Mixture-of-Experts FFN (expert parallelism).
+
+The reference has no MoE (SURVEY.md §2: EP absent — "models are tiny");
+this layer is part of the rebuild's distributed superset and is designed
+for the TPU from the start:
+
+- **Static shapes**: routing uses the classic capacity-based one-hot
+  dispatch/combine formulation (Mesh-TensorFlow / Switch Transformer
+  lineage, PAPERS.md pattern only): every tensor is a fixed-size einsum
+  operand, so the whole layer is jit-compatible and lands on the MXU —
+  no ragged gathers, no data-dependent shapes.
+- **Expert parallelism**: the expert banks are stacked ``(E, ...)`` params
+  named ``experts_*``; parallel/tp.py shards their leading dim over the
+  ``model`` mesh axis, and the GSPMD partitioner turns the dispatch/expert/
+  combine einsums into per-shard matmuls plus the EP collectives.
+- **Aux load-balance loss** (Switch: ``E · Σ_e f_e · p_e``) is ``sow``-n
+  into the ``intermediates`` collection; the local trainer picks it up
+  when training (fed/local.py) and it is a silent no-op everywhere else
+  (flax ``sow`` does nothing when the collection is immutable).
+
+Routing is top-2 with renormalized gates; tokens beyond an expert's
+capacity ``C = ceil(top_k·N/E · capacity_factor)`` are dropped (their
+block output is zero and the residual connection carries them through).
+The encoder that hosts this layer is models/bert.py (``num_experts > 0``
+swaps the block MLP for this module in every other block); under sequence
+parallelism each sequence shard routes its LOCAL tokens with local
+capacity — the standard choice, avoiding an all-to-all over the seq axis.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEFfn(nn.Module):
+    """Capacity-based top-k mixture of expert FFNs over tokens."""
+
+    embed_dim: int
+    num_experts: int
+    mlp_ratio: int = 4
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, D = x.shape
+        E, K = self.num_experts, min(self.top_k, self.num_experts)
+        F = D * self.mlp_ratio
+        N = B * S
+        C = max(1, int(-(-K * N * self.capacity_factor // E)))  # ceil
+
+        xf = x.reshape(N, D)
+        # Router in float32 for stable softmax; kept replicated (tp rules).
+        logits = nn.Dense(E, dtype=jnp.float32, name="router")(
+            xf.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)                  # (N, E)
+
+        gate_vals, expert_idx = jax.lax.top_k(probs, K)          # (N, K)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9
+        )
+
+        # Positions within each expert's buffer, rank-major: all rank-0
+        # picks fill before any rank-1 pick, so primary routes win capacity.
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (N, K, E)
+        flat = onehot.transpose(1, 0, 2).reshape(K * N, E)       # rank-major
+        pos_f = jnp.cumsum(flat, axis=0) - flat                  # (K*N, E)
+        pos = (
+            pos_f.reshape(K, N, E).transpose(1, 0, 2) * onehot
+        ).sum(-1)                                                # (N, K)
+
+        # dispatch (N, E, C): one-hot of (expert, position); over-capacity
+        # tokens fall out because one_hot(pos >= C) is the zero row.
+        # combine carries the gate weight on top.
+        disp = (
+            jax.nn.one_hot(expert_idx, E, dtype=self.dtype)[..., None]
+            * jax.nn.one_hot(pos, C, dtype=self.dtype)[:, :, None, :]
+        )                                                        # (N, K, E, C)
+        combine = (disp * gate_vals[..., None, None].astype(self.dtype)).sum(1)
+        disp = disp.sum(1)                                       # (N, E, C)
+
+        up = self.param(
+            "experts_up", nn.initializers.lecun_normal(), (E, D, F)
+        ).astype(self.dtype)
+        b_up = self.param(
+            "experts_up_bias", nn.initializers.zeros, (E, F)
+        ).astype(self.dtype)
+        down = self.param(
+            "experts_down", nn.initializers.lecun_normal(), (E, F, D)
+        ).astype(self.dtype)
+        b_down = self.param(
+            "experts_down_bias", nn.initializers.zeros, (E, D)
+        ).astype(self.dtype)
+
+        xin = jnp.einsum("nec,nd->ecd", disp, xf.astype(self.dtype))
+        h = nn.gelu(jnp.einsum("ecd,edf->ecf", xin, up) + b_up[:, None, :])
+        y = jnp.einsum("ecf,efd->ecd", h, down) + b_down[:, None, :]
+        out = jnp.einsum("nec,ecd->nd", combine, y)
+
+        # Switch aux loss: E * sum_e fraction_routed_e * mean_prob_e over
+        # PRIMARY routes (minimized at uniform balance, value 1.0).
+        f_e = jnp.mean(
+            jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0
+        )
+        p_e = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "moe_aux", E * jnp.sum(f_e * p_e))
+
+        return out.reshape(B, S, D)

@@ -20,9 +20,10 @@ def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
     inside ``shard_map`` with the sequence dim sharded over that axis.
     """
     dtype = _dtype(cfg)
-    if seq_axis_name is not None and cfg.name != "bert":
+    if seq_axis_name is not None and cfg.name not in ("bert", "moe_bert"):
         raise ValueError(
-            f"sequence parallelism is only supported for 'bert', not {cfg.name!r}"
+            "sequence parallelism is only supported for 'bert'/'moe_bert', "
+            f"not {cfg.name!r}"
         )
     if cfg.name == "mlp":
         from colearn_federated_learning_tpu.models.mlp import MLP
@@ -45,6 +46,18 @@ def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
                               num_heads=cfg.num_heads, max_len=cfg.seq_len,
                               dtype=dtype, attn_impl=cfg.attn_impl,
                               seq_axis_name=seq_axis_name)
+    if cfg.name == "moe_bert":
+        from colearn_federated_learning_tpu.models.bert import BertClassifier
+
+        # Same encoder as "bert" with MoE FFN blocks interleaved
+        # (models/moe.py; expert banks shard over the model axis).
+        return BertClassifier(num_classes=cfg.num_classes,
+                              vocab_size=cfg.vocab_size, embed_dim=cfg.width,
+                              depth=cfg.depth, num_heads=cfg.num_heads,
+                              max_len=cfg.seq_len, dtype=dtype,
+                              attn_impl=cfg.attn_impl,
+                              seq_axis_name=seq_axis_name,
+                              num_experts=cfg.num_experts)
     if cfg.name == "vit_b16":
         from colearn_federated_learning_tpu.models.vit import ViT
 

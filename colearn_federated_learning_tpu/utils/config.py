@@ -36,6 +36,8 @@ class ModelConfig:
     vocab_size: int = 30522           # BERT wordpiece vocab size
     dtype: str = "float32"            # compute dtype ("bfloat16" on TPU)
     attn_impl: str = "dense"          # "dense" | "flash" (pallas) | "ring" (SP)
+    num_experts: int = 4              # MoE families (models/moe.py)
+    moe_aux_weight: float = 0.01      # Switch load-balance loss weight
 
 
 @dataclasses.dataclass(frozen=True)
