@@ -41,7 +41,9 @@ class TransformerBlock(nn.Module):
             from colearn_federated_learning_tpu.models.moe import MoEFfn
 
             h = MoEFfn(self.embed_dim, self.num_experts,
-                       mlp_ratio=self.mlp_ratio, dtype=self.dtype)(x)
+                       mlp_ratio=self.mlp_ratio, dtype=self.dtype)(
+                x, token_mask=pad_mask
+            )
         else:
             h = nn.Dense(self.embed_dim * self.mlp_ratio, dtype=self.dtype)(x)
             h = nn.gelu(h)

@@ -45,7 +45,11 @@ class MoEFfn(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, token_mask=None):
+        """``x``: (B, S, D); ``token_mask``: optional (B, S) bool, False =
+        padding.  Masked tokens are excluded from routing entirely — they
+        claim no expert capacity, produce zero layer output (the residual
+        carries them), and do not enter the load-balance statistics."""
         B, S, D = x.shape
         E, K = self.num_experts, min(self.top_k, self.num_experts)
         F = D * self.mlp_ratio
@@ -64,9 +68,18 @@ class MoEFfn(nn.Module):
             gate_vals.sum(-1, keepdims=True), 1e-9
         )
 
+        # (N, K, E) routing one-hot — the single source for capacity
+        # accounting, dispatch, and the aux statistics.  Padding tokens are
+        # zeroed BEFORE the cumsum so they never occupy a capacity slot.
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)
+        if token_mask is not None:
+            mf = token_mask.reshape(N).astype(jnp.float32)        # (N,)
+            onehot = onehot * token_mask.reshape(N, 1, 1).astype(jnp.int32)
+        else:
+            mf = jnp.ones((N,), jnp.float32)
+
         # Positions within each expert's buffer, rank-major: all rank-0
         # picks fill before any rank-1 pick, so primary routes win capacity.
-        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (N, K, E)
         flat = onehot.transpose(1, 0, 2).reshape(K * N, E)       # rank-major
         pos_f = jnp.cumsum(flat, axis=0) - flat                  # (K*N, E)
         pos = (
@@ -74,10 +87,11 @@ class MoEFfn(nn.Module):
         ).sum(-1)                                                # (N, K)
 
         # dispatch (N, E, C): one-hot of (expert, position); over-capacity
-        # tokens fall out because one_hot(pos >= C) is the zero row.
+        # tokens fall out because one_hot(pos >= C) is the zero row, and
+        # masked tokens because their routing one-hot is already zero.
         # combine carries the gate weight on top.
         disp = (
-            jax.nn.one_hot(expert_idx, E, dtype=self.dtype)[..., None]
+            onehot.astype(self.dtype)[..., None]
             * jax.nn.one_hot(pos, C, dtype=self.dtype)[:, :, None, :]
         )                                                        # (N, K, E, C)
         combine = (disp * gate_vals[..., None, None].astype(self.dtype)).sum(1)
@@ -102,11 +116,11 @@ class MoEFfn(nn.Module):
         out = jnp.einsum("nec,ecd->nd", combine, y)
 
         # Switch aux loss: E * sum_e fraction_routed_e * mean_prob_e over
-        # PRIMARY routes (minimized at uniform balance, value 1.0).
-        f_e = jnp.mean(
-            jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0
-        )
-        p_e = jnp.mean(probs, axis=0)
+        # PRIMARY routes of REAL tokens (minimized at uniform balance,
+        # value 1.0).  Masked tokens are excluded from both statistics.
+        denom = jnp.maximum(mf.sum(), 1.0)
+        f_e = onehot[:, 0, :].astype(jnp.float32).sum(axis=0) / denom
+        p_e = (probs * mf[:, None]).sum(axis=0) / denom
         self.sow("intermediates", "moe_aux", E * jnp.sum(f_e * p_e))
 
         return out.reshape(B, S, D)

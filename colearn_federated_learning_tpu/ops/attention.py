@@ -35,6 +35,16 @@ from jax.experimental import pallas as pl
 
 _NEG = -1e30
 
+def _tpu_params():
+    """Mosaic compiler params for the non-interpret (real TPU) path: the
+    default 16 MB scoped-vmem cap rejects the fast 512-block configuration
+    beyond L≈4k; the v5e has 128 MB physical VMEM, so raise the cap and
+    let the (bq, bk) f32 score tiles + whole-row K/V residency fit
+    (measured: L=32k fwd+bwd needs ~100 MB of scoped buffers)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(vmem_limit_bytes=112 * 1024 * 1024)
+
 
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
@@ -59,15 +69,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     num_kb = Lk // block_k
     qb = pl.program_id(1)
 
-    q = q_ref[0].astype(jnp.float32) * scale                 # (bq, D)
+    # Keep the model dtype (bf16 on TPU) INTO the dots: the MXU runs
+    # bf16×bf16→f32 at full rate, while f32×f32 costs ~4× — casting up
+    # front would throw away most of the kernel's throughput.  All
+    # accumulation (m/l/acc, softmax math) stays float32.
+    q = q_ref[0]                                             # (bq, D)
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (bq, bk)
-        s = s + bias_ref[0, pl.ds(kb * block_k, block_k), 0][None, :]
+        s = s * scale + bias_ref[0, pl.ds(kb * block_k, block_k), 0][None, :]
         if causal:
             q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -80,7 +94,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1, keepdims=True)
         acc_new = acc * corr + lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
@@ -154,6 +168,7 @@ def _flash_impl(q, k, v, kv_mask, causal: bool,
             jax.ShapeDtypeStruct((B * H, Lq_p, 1), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
     )(qr, kr, vr, bias)
     out = out.reshape(B, H, Lq_p, D).transpose(0, 2, 1, 3)[:, :Lq]
     if return_lse:
@@ -170,17 +185,19 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     num_kb = Lk // block_k
     qb = pl.program_id(1)
 
-    qs = q_ref[0].astype(jnp.float32) * scale                # (bq, D)
-    do = do_ref[0].astype(jnp.float32)                       # (bq, D)
+    # Model-dtype (bf16) operands into every dot, f32 accumulation out —
+    # see _flash_kernel.  The softmax scale folds into s post-dot.
+    q = q_ref[0]                                             # (bq, D)
+    do = do_ref[0]                                           # (bq, D)
     lse = lse_ref[0]                                         # (bq, 1)
     delta = delta_ref[0]                                     # (bq, 1)
 
     def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())),
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        s = s + bias_ref[0, pl.ds(kb * block_k, block_k), 0][None, :]
+        s = s * scale + bias_ref[0, pl.ds(kb * block_k, block_k), 0][None, :]
         if causal:
             q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -190,13 +207,15 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
+        return dq + lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     if causal:
         num_kb = jnp.minimum(num_kb, pl.cdiv((qb + 1) * block_q, block_k))
     dq = lax.fori_loop(
-        0, num_kb, body, jnp.zeros((qs.shape[0], qs.shape[1]), jnp.float32)
+        0, num_kb, body, jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
     )
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
@@ -210,32 +229,39 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     num_qb = Lq // block_q
     kb = pl.program_id(1)
 
-    k_blk = k_ref[0].astype(jnp.float32)                     # (bk, D)
-    v_blk = v_ref[0].astype(jnp.float32)
+    # Model-dtype (bf16) operands into every dot, f32 accumulation out —
+    # see _flash_kernel.  The softmax scale is applied to s post-dot and
+    # folded into dk once at the end (dk = scale · Σ ds^T q).
+    k_blk = k_ref[0]                                         # (bk, D)
+    v_blk = v_ref[0]
     bias = bias_ref[0, :, 0][None, :]                        # (1, bk)
 
     def body(qb, carry):
         dk, dv = carry
-        qs = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]    # (bq, 1)
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
-        s = lax.dot_general(qs, k_blk, (((1,), (1,)), ((), ())),
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)   # (bq, bk)
-        s = s + bias
+        s = s * scale + bias
         if causal:
             q_pos = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
         p = jnp.exp(s - lse)
         p = jnp.where(s > 0.5 * _NEG, p, 0.0)
-        dv_new = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dv_new = dv + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         dp = lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk_new = dk + lax.dot_general(ds, qs, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        dk_new = dk + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         return dk_new, dv_new
 
     qb0 = 0
@@ -248,7 +274,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         (jnp.zeros((k_blk.shape[0], D), jnp.float32),
          jnp.zeros((k_blk.shape[0], D), jnp.float32)),
     )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -282,6 +308,7 @@ def _flash_bwd_impl(q, k, v, kv_mask, out, lse, g, causal,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Lq_p, D), q.dtype),
         interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
     )(qr, kr, vr, bias, gr, lse, delta)
 
     dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=bq, scale=scale,
@@ -307,6 +334,7 @@ def _flash_bwd_impl(q, k, v, kv_mask, out, lse, g, causal,
             jax.ShapeDtypeStruct((B * H, Lk_p, D), v.dtype),
         ],
         interpret=interpret,
+        compiler_params=None if interpret else _tpu_params(),
     )(qr, kr, vr, bias, gr, lse, delta)
 
     def from_rows(a, L, L_p):
@@ -347,8 +375,8 @@ def flash_attention(
     kv_mask: Optional[jax.Array] = None,
     *,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Blockwise (flash) attention over ``(B, L, H, D)`` tensors.
