@@ -52,6 +52,13 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dp-noise-multiplier", type=float, default=None)
     p.add_argument("--dp-delta", type=float, default=None,
                    help="δ at which the RDP accountant reports ε")
+    p.add_argument("--dp-adaptive-clip", action="store_true", default=None,
+                   help="track the --dp-target-quantile of update norms "
+                        "(--dp-clip becomes the initial norm)")
+    p.add_argument("--dp-target-quantile", type=float, default=None)
+    p.add_argument("--dp-clip-lr", type=float, default=None)
+    p.add_argument("--dp-bit-noise", type=float, default=None,
+                   help="σ_b on the quantile-bit sum (0 = cohort/20)")
     p.add_argument("--secure-agg", action="store_true", default=None)
     p.add_argument("--secure-agg-neighbors", type=int, default=None,
                    help="k-regular random-ring masking (0 = all pairs)")
@@ -73,8 +80,9 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "batch_size", "lr", "momentum", "local_optimizer", "strategy",
              "prox_mu", "dp_clip", "dp_noise_multiplier", "dp_delta",
-             "secure_agg", "secure_agg_neighbors", "straggler_prob",
-             "compress"}
+             "dp_adaptive_clip", "dp_target_quantile", "dp_clip_lr",
+             "dp_bit_noise", "secure_agg", "secure_agg_neighbors",
+             "straggler_prob", "compress"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}
@@ -214,6 +222,30 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
     )
 
     config = config_from_args(args)
+    if args.async_buffer:
+        from colearn_federated_learning_tpu.comm.async_coordinator import (
+            AsyncFederatedCoordinator,
+        )
+
+        coord = AsyncFederatedCoordinator(
+            config, args.broker_host, args.broker_port,
+            buffer_size=args.async_buffer,
+            request_timeout=args.round_timeout,
+            want_evaluator=not args.no_evaluator,
+        )
+        with coord:
+            if args.resume:
+                step = coord.restore_checkpoint()
+                print(f"resumed at model version {step}", file=sys.stderr)
+            coord.enroll(min_devices=args.min_devices,
+                         timeout=args.enroll_timeout)
+            remaining = max(0, config.fed.rounds - len(coord.history))
+            hist = coord.fit(
+                aggregations=remaining,
+                log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+            )
+            print(json.dumps(hist[-1]))
+        return 0
     coord = FederatedCoordinator(config, args.broker_host, args.broker_port,
                                  round_timeout=args.round_timeout,
                                  want_evaluator=not args.no_evaluator)
@@ -314,6 +346,11 @@ def main(argv: list[str] | None = None) -> int:
     p_coord.add_argument("--resume", action="store_true",
                          help="restore the latest checkpoint from "
                               "--checkpoint-dir before training")
+    p_coord.add_argument("--async-buffer", type=int, default=0,
+                         help="> 0 switches to buffered-asynchronous "
+                              "aggregation (FedBuff-style): apply the "
+                              "staleness-weighted mean every N updates "
+                              "instead of running synchronous rounds")
     p_coord.set_defaults(fn=cmd_coordinate)
 
     p_bench = sub.add_parser("bench", help="run the headline benchmark")

@@ -1,0 +1,62 @@
+"""Weighted folding of client updates — shared by both socket coordinators.
+
+The synchronous round loop (comm/coordinator.py) and the buffered
+asynchronous aggregator (comm/async_coordinator.py) accumulate the same
+thing: decompressed client deltas scaled by their aggregation weight, a
+running weight total, and a weighted loss.  One helper keeps the two
+planes' aggregation math identical (decompression, weighting, the guarded
+zero-weight mean) — the host-side mirror of the engine's in-XLA
+``tree_weighted_sum`` / ``_finish_round`` pair.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from colearn_federated_learning_tpu.utils import pytrees
+
+
+class UpdateFolder:
+    """Accumulate weighted client deltas; ``mean()`` is None-safe."""
+
+    def __init__(self, shapes: Any):
+        self.shapes = shapes            # params-shaped numpy pytree
+        self.wsum: Optional[Any] = None
+        self.total_w = 0.0
+        self.loss_sum = 0.0
+        self.count = 0
+
+    def add(self, meta: dict, delta: Any,
+            weight: Optional[float] = None) -> float:
+        """Fold one update.  ``weight`` overrides the worker-reported
+        ``meta["weight"]`` (the async plane multiplies in its staleness
+        discount).  Returns the weight actually applied."""
+        from colearn_federated_learning_tpu.fed import compression
+
+        delta = compression.decompress_delta(delta, meta, shapes=self.shapes)
+        w = float(meta.get("weight", 1.0)) if weight is None else float(weight)
+        contrib = pytrees.tree_scale(jax.tree.map(np.asarray, delta), w)
+        self.wsum = (
+            contrib if self.wsum is None
+            else pytrees.tree_add(self.wsum, contrib)
+        )
+        self.total_w += w
+        self.loss_sum += float(meta.get("mean_loss", 0.0)) * w
+        self.count += 1
+        return w
+
+    def mean(self) -> tuple[Optional[Any], float, float]:
+        """(mean_delta | None, total_weight, weighted_mean_loss).  A fold
+        with zero total weight yields (None, 0, nan) — callers skip the
+        server step rather than divide by zero."""
+        if self.total_w <= 0.0:
+            return None, 0.0, math.nan
+        return (
+            pytrees.tree_scale(self.wsum, 1.0 / self.total_w),
+            self.total_w,
+            self.loss_sum / self.total_w,
+        )

@@ -1,0 +1,320 @@
+"""Asynchronous (buffered) federated coordinator over the socket planes.
+
+The reference's round loop — like the synchronous coordinator here
+(comm/coordinator.py, SURVEY.md §3a) — is BULK-synchronous: every round
+waits on a deadline for the whole cohort, so one slow device stalls the
+federation.  This coordinator is the buffered-asynchronous alternative
+(FedBuff lineage — Nguyen et al. 2106.06639, PAPERS.md pattern only):
+
+- one dispatcher thread per trainer keeps that device continuously busy:
+  snapshot the CURRENT global model, request local training, enqueue the
+  returned delta tagged with the model version it started from;
+- the aggregator applies the buffer as soon as ``buffer_size`` updates
+  arrive — no deadline, no stragglers: a slow device just contributes to a
+  later aggregation with a staleness discount;
+- staleness weighting: an update trained on version ``v`` applied at
+  version ``t`` is scaled by ``(1 + t - v)^(-staleness_exponent)``
+  (FedBuff's 1/sqrt(1+τ) at the default 0.5), and updates older than
+  ``max_staleness`` are discarded outright;
+- the server step reuses the SAME fed/strategies.py update the jit engine
+  and the synchronous coordinator use.
+
+Workers are completely unchanged: a train request carries the model
+version in the ``round`` field, and the worker's per-(client, round) PRNG
+keys make its minibatch stream deterministic per version.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from colearn_federated_learning_tpu.comm.broker import BrokerClient
+from colearn_federated_learning_tpu.comm.enrollment import (
+    DeviceInfo,
+    EnrollmentManager,
+)
+from colearn_federated_learning_tpu.comm.transport import TensorClient
+from colearn_federated_learning_tpu.fed import setup as setup_lib
+from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+class AsyncFederatedCoordinator:
+    """Buffered-asynchronous aggregation server (see module docstring)."""
+
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        broker_host: str,
+        broker_port: int,
+        buffer_size: int = 4,
+        staleness_exponent: float = 0.5,
+        max_staleness: int = 10,
+        request_timeout: float = 60.0,
+        want_evaluator: bool = True,
+    ):
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        if config.fed.dp_clip > 0.0 or config.fed.dp_noise_multiplier > 0.0:
+            raise NotImplementedError(
+                "asynchronous aggregation with DP is unsupported: the "
+                "staleness-discounted weights break the uniform-weighting "
+                "sensitivity analysis the clip+noise calibration assumes, "
+                "and no async accountant is implemented; use the "
+                "synchronous coordinator for DP runs"
+            )
+        self.config = config
+        self.buffer_size = buffer_size
+        self.staleness_exponent = staleness_exponent
+        self.max_staleness = max_staleness
+        self.request_timeout = request_timeout
+        self.want_evaluator = want_evaluator
+        self._broker = BrokerClient(broker_host, broker_port)
+        self._enroll = EnrollmentManager(self._broker)
+        params = setup_lib.init_global_params(config)
+        self.server_state = strategies.init_server_state(params, config.fed)
+        self.version = 0                       # server model version t
+        self.history: list[dict] = []
+        self.trainers: list[DeviceInfo] = []
+        self.evaluator: Optional[DeviceInfo] = None
+        self._clients: dict[str, TensorClient] = {}
+        self._results: queue.Queue = queue.Queue()
+        self._state_lock = threading.Lock()
+        self._version_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.failures: dict[str, int] = {}
+        self._ckpt = None
+
+    # ------------------------------------------------------------------
+    def enroll(self, min_devices: int, timeout: float = 30.0) -> None:
+        self._enroll.wait_for(min_devices, timeout)
+        self.trainers, self.evaluator = self._enroll.assign_roles(
+            want_evaluator=self.want_evaluator
+        )
+        for d in self.trainers + ([self.evaluator] if self.evaluator else []):
+            self._clients[d.device_id] = TensorClient(d.host, d.port)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2 * self.request_timeout)
+        for c in self._clients.values():
+            c.close()
+        self._broker.close()
+        if self._ckpt is not None:
+            self._ckpt.close()
+            self._ckpt = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    def _snapshot(self):
+        """(version, params-as-numpy) under the state lock — dispatchers
+        must never read params mid-server-update."""
+        with self._state_lock:
+            return self.version, jax.tree.map(
+                np.asarray, self.server_state.params
+            )
+
+    def _dispatch_loop(self, dev: DeviceInfo) -> None:
+        """One device's pump: train on the freshest model, enqueue, repeat.
+
+        At most ONE training run per (device, model version): a worker's
+        local update is deterministic per version (per-(client, round) PRNG
+        keys), so re-dispatching the same version would enqueue byte-equal
+        duplicates — a fast device could then dominate the buffer with
+        copies of one update while slower peers compile.  The pump blocks
+        on the version condition until the aggregator advances."""
+        cli = self._clients[dev.device_id]
+        last_v = -1
+        while not self._stop.is_set():
+            with self._version_cv:
+                while self.version == last_v and not self._stop.is_set():
+                    self._version_cv.wait(0.1)
+            if self._stop.is_set():
+                return
+            v, params_np = self._snapshot()
+            try:
+                header, delta = cli.request(
+                    {"op": "train", "round": v}, params_np,
+                    meta={"round": v}, timeout=self.request_timeout,
+                )
+                if header.get("status") != "ok":
+                    raise RuntimeError(header.get("error"))
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self.failures[dev.device_id] = (
+                    self.failures.get(dev.device_id, 0) + 1
+                )
+                # Replace the connection (a late reply on the old socket
+                # would desynchronise the request/reply stream), back off,
+                # and RETRY the same version — last_v only advances on
+                # success, so a flaky device can't starve an aggregation
+                # that still needs its update.
+                try:
+                    cli.close()
+                    cli = TensorClient(dev.host, dev.port)
+                    self._clients[dev.device_id] = cli
+                except OSError:
+                    pass
+                self._stop.wait(0.2)
+                continue
+            last_v = v
+            self._results.put((dev.device_id, header["meta"], delta, v))
+
+    def _start_dispatchers(self) -> None:
+        if self._threads:
+            return
+        for d in self.trainers:
+            t = threading.Thread(target=self._dispatch_loop, args=(d,),
+                                 daemon=True, name=f"dispatch-{d.device_id}")
+            t.start()
+            self._threads.append(t)
+
+    # ------------------------------------------------------------------
+    def run_aggregation(self) -> dict:
+        """Block until ``buffer_size`` fresh-enough updates arrived, then
+        apply the staleness-weighted mean as one server step.  Raises
+        RuntimeError (with per-device failure counts) if the federation
+        produces nothing for ``2 × request_timeout`` — dispatchers retry
+        dead peers forever, so the aggregator owns the escalation."""
+        from colearn_federated_learning_tpu.comm.aggregation import (
+            UpdateFolder,
+        )
+
+        if self.buffer_size > len(self.trainers):
+            raise ValueError(
+                f"buffer_size {self.buffer_size} exceeds the "
+                f"{len(self.trainers)} enrolled trainers: each device "
+                "contributes at most one update per model version, so the "
+                "buffer could never fill"
+            )
+        self._start_dispatchers()
+        t0 = time.perf_counter()
+        # Only the aggregator mutates server state, so one shape snapshot
+        # serves the whole collection loop.
+        folder = UpdateFolder(jax.tree.map(np.asarray,
+                                           self.server_state.params))
+        staleness: list[int] = []
+        contributors: list[str] = []
+        discarded = 0
+        stall_deadline = t0 + 2.0 * self.request_timeout
+        while len(staleness) < self.buffer_size:
+            try:
+                dev_id, meta, delta, v = self._results.get(
+                    timeout=max(0.1, stall_deadline - time.perf_counter())
+                )
+            except queue.Empty:
+                raise RuntimeError(
+                    f"no update arrived within {2 * self.request_timeout:.0f}s "
+                    f"({len(staleness)}/{self.buffer_size} buffered); "
+                    f"device failures: {dict(self.failures)}"
+                ) from None
+            stall_deadline = time.perf_counter() + 2.0 * self.request_timeout
+            tau = self.version - v
+            if tau > self.max_staleness:
+                discarded += 1
+                continue
+            folder.add(meta, delta,
+                       weight=float(meta.get("weight", 1.0))
+                       * (1.0 + tau) ** (-self.staleness_exponent))
+            staleness.append(tau)
+            contributors.append(dev_id)
+
+        mean_delta, total_w, mean_loss = folder.mean()
+        with self._state_lock:
+            if mean_delta is not None:
+                self.server_state = strategies.server_update(
+                    self.server_state, mean_delta, self.config.fed
+                )
+            self.version += 1
+        with self._version_cv:
+            self._version_cv.notify_all()     # wake pumps for the new version
+        rec = {
+            "aggregation": len(self.history),
+            "model_version": self.version,
+            "buffer_size": self.buffer_size,
+            "staleness_mean": float(np.mean(staleness)),
+            "staleness_max": int(np.max(staleness)),
+            "discarded": discarded,
+            "contributors": contributors,
+            "train_loss": mean_loss,
+            "total_weight": total_w,
+            "agg_time_s": time.perf_counter() - t0,
+        }
+        self.history.append(rec)
+        return rec
+
+    def evaluate(self) -> dict:
+        if self.evaluator is None:
+            raise RuntimeError("no evaluator was assigned")
+        params_np = jax.tree.map(np.asarray, self.server_state.params)
+        header, _ = self._clients[self.evaluator.device_id].request(
+            {"op": "eval"}, params_np, timeout=self.request_timeout
+        )
+        if header.get("status") != "ok":
+            raise RuntimeError(f"evaluator failed: {header.get('error')}")
+        return header["meta"]
+
+    # ---- checkpoint/resume (same RoundCheckpointer as the engine) --------
+    def _checkpointer(self):
+        if self._ckpt is None:
+            from colearn_federated_learning_tpu.ckpt import RoundCheckpointer
+
+            self._ckpt = RoundCheckpointer.for_run(self.config.run)
+        return self._ckpt
+
+    def save_checkpoint(self) -> None:
+        self._checkpointer().save(
+            self.version, (self.server_state,), self.history
+        )
+
+    def restore_checkpoint(self) -> int:
+        """Restore the latest checkpoint; returns the resumed model
+        version.  Call BEFORE ``enroll``/``fit`` — the dispatcher pumps
+        snapshot the restored state on their first cycle."""
+        state, history, step = self._checkpointer().restore(
+            (self.server_state,)
+        )
+        (self.server_state,) = state
+        self.history = history
+        self.version = step
+        return step
+
+    def fit(self, aggregations: int, log_fn=None,
+            eval_every: Optional[int] = None) -> list[dict]:
+        eval_every = eval_every or self.config.run.eval_every
+        run = self.config.run
+        ckpt_every = max(0, run.checkpoint_every)
+        want_ckpt = bool(run.checkpoint_dir)
+        # rec["aggregation"] is a CUMULATIVE index (repeated fit() calls
+        # continue the history), so the final-eval/-checkpoint marker is
+        # relative to where this call started.
+        last = len(self.history) + aggregations - 1
+        for _ in range(aggregations):
+            rec = self.run_aggregation()
+            if self.evaluator is not None and (
+                rec["aggregation"] % max(1, eval_every) == 0
+                or rec["aggregation"] == last
+            ):
+                rec.update(self.evaluate())
+            if log_fn is not None:
+                log_fn(rec)
+            if want_ckpt and (
+                (ckpt_every and (rec["aggregation"] + 1) % ckpt_every == 0)
+                or rec["aggregation"] == last
+            ):
+                self.save_checkpoint()
+        return self.history

@@ -33,7 +33,6 @@ from colearn_federated_learning_tpu.comm.enrollment import (
 from colearn_federated_learning_tpu.comm.transport import TensorClient
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
-from colearn_federated_learning_tpu.utils import pytrees
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 
 
@@ -194,24 +193,20 @@ class FederatedCoordinator:
                     dropped.append(dev.device_id)
                     self._reconnect(dev)
 
-        from colearn_federated_learning_tpu.fed import compression
+        from colearn_federated_learning_tpu.comm.aggregation import (
+            UpdateFolder,
+        )
 
-        wsum, total_w, loss_sum, folded = None, 0.0, 0.0, 0
+        folder = UpdateFolder(params_np)
         for meta, delta in results:
             if int(meta.get("round", r)) != r:       # stale update: refuse
                 dropped.append(str(meta.get("client_id")))
                 continue
-            delta = compression.decompress_delta(delta, meta,
-                                                 shapes=params_np)
-            w = float(meta.get("weight", 1.0))
-            contrib = pytrees.tree_scale(jax.tree.map(np.asarray, delta), w)
-            wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)
-            total_w += w
-            loss_sum += float(meta.get("mean_loss", 0.0)) * w
-            folded += 1
+            folder.add(meta, delta)
+        folded = folder.count
 
-        if total_w > 0:
-            mean_delta = pytrees.tree_scale(wsum, 1.0 / total_w)
+        mean_delta, total_w, mean_loss = folder.mean()
+        if mean_delta is not None:
             self.server_state = strategies.server_update(
                 self.server_state, mean_delta, self.config.fed
             )
@@ -222,7 +217,7 @@ class FederatedCoordinator:
             "cohort": len(cohort),
             "dropped": dropped,
             "evicted": evicted,
-            "train_loss": loss_sum / total_w if total_w else float("nan"),
+            "train_loss": mean_loss,
             "total_weight": total_w,
             "round_time_s": time.perf_counter() - t0,
         }

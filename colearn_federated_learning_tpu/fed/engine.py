@@ -312,6 +312,36 @@ class FederatedLearner:
         # drop mid-round the realized central noise is below nominal — a
         # known property of DP-FedAvg with dropouts; see privacy/dp.py.
         self.dp_cohort = min(self.cohort_size, self.real_num_clients)
+        # Adaptive clipping (privacy/dp.py, quantile tracking): the clip
+        # norm is a DEVICE scalar threaded operand -> metric through the
+        # round program, so back-to-back rounds adapt it with no host sync.
+        self.adaptive_clip = c.fed.dp_adaptive_clip
+        if self.adaptive_clip:
+            if c.fed.dp_clip <= 0.0:
+                raise ValueError(
+                    "dp_adaptive_clip needs dp_clip > 0 as the initial norm"
+                )
+            if c.fed.secure_agg:
+                raise ValueError(
+                    "dp_adaptive_clip with secure_agg is unsupported: the "
+                    "quantile bits are a second scalar payload the pairwise "
+                    "masks do not cover"
+                )
+            z = c.fed.dp_noise_multiplier
+            if z > 0.0:
+                self.dp_bit_noise = c.fed.dp_bit_noise or max(
+                    self.dp_cohort / 20.0, 1.0
+                )
+                # The bit query spends part of the budget; the update noise
+                # is inflated so the JOINT per-round mechanism still costs
+                # the configured z — the accountant below stays valid as-is.
+                self.dp_z = dp_lib.adaptive_noise_multiplier(
+                    z, self.dp_bit_noise
+                )
+            else:
+                self.dp_bit_noise = 0.0
+                self.dp_z = 0.0
+        self._dp_clip = jnp.float32(c.fed.dp_clip)
         # RDP accountant: cumulative (ε, δ) per round when DP is on
         # (privacy/accountant.py; each round is one subsampled Gaussian
         # mechanism with q = cohort / N at central noise σ).
@@ -356,7 +386,7 @@ class FederatedLearner:
     # ------------------------------------------------------------------
     def _cohort_step(self, params, local_ids, global_ids, mask_cohort_ids,
                      x, y, counts, key, round_idx,
-                     control=None, c_blk=None):
+                     control=None, c_blk=None, clip=None):
         """Shared per-cohort logic: local training + privacy + weighting.
 
         ``local_ids`` index into the (possibly per-device) ``x/y/counts``
@@ -414,13 +444,23 @@ class FederatedLearner:
         # SCAFFOLD averages uniformly over the sampled cohort (the variate
         # algebra assumes it); DP/secure-agg force uniform weights too.
         uniform_weights = c.dp_clip > 0.0 or c.secure_agg or self.scaffold
+        bits = None
         if c.dp_clip > 0.0:
             dp_keys = jax.vmap(lambda i: prng.dp_key(key, i, round_idx))(global_ids)
-            deltas = jax.vmap(
-                lambda d, k: dp_lib.clip_and_noise(
-                    d, c.dp_clip, c.dp_noise_multiplier, self.dp_cohort, k
-                )
-            )(deltas, dp_keys)
+            if self.adaptive_clip:
+                # Traced clip scalar + per-client quantile bit (pre-clip
+                # norm <= clip), update noise at the inflated multiplier.
+                deltas, bits = jax.vmap(
+                    lambda d, k: dp_lib.clip_and_noise_with_bit(
+                        d, clip, self.dp_z, self.dp_cohort, k
+                    )
+                )(deltas, dp_keys)
+            else:
+                deltas = jax.vmap(
+                    lambda d, k: dp_lib.clip_and_noise(
+                        d, c.dp_clip, c.dp_noise_multiplier, self.dp_cohort, k
+                    )
+                )(deltas, dp_keys)
 
         nonghost = (results.num_examples > 0)
         if uniform_weights:
@@ -455,6 +495,12 @@ class FederatedLearner:
         # always finish their budget but never contribute).
         contrib = completed & nonghost
         n_completed = jnp.sum(contrib.astype(jnp.int32))
+        # Quantile-bit sum over CONTRIBUTORS (the clip adapts to the norms
+        # that actually entered the aggregate).
+        bit_sum = (
+            jnp.sum(bits * contrib.astype(jnp.float32))
+            if bits is not None else jnp.zeros((), jnp.float32)
+        )
 
         extras = None
         if self.scaffold:
@@ -470,10 +516,11 @@ class FederatedLearner:
                 sres.c_new, c_i,
             )
             extras = (dc_sum, n_completed.astype(jnp.float32), c_masked)
-        return wsum, total_w, (loss_sum, n_completed), extras
+        return wsum, total_w, (loss_sum, n_completed, bit_sum), extras
 
     def _finish_round(self, server_state, wsum, total_w, loss_sum, n_comp,
-                      dc_sum=None, n_contrib=None):
+                      dc_sum=None, n_contrib=None, bit_sum=None, clip=None,
+                      key=None, round_idx=None):
         """Shared round epilogue (vmap and shard_map paths): mean delta,
         server update, metrics.  Zero contributors (all stragglers) → no-op
         update; the explicit gate matters under secure_agg, where wsum is
@@ -498,6 +545,30 @@ class FederatedLearner:
             "completed": n_comp,
             "total_weight": total_w,
         }
+        if self.adaptive_clip:
+            # Noised quantile fraction -> geometric clip step.  In the
+            # shard_map path this runs replicated AFTER the psums: every
+            # device derives the identical noise from the shared key, so
+            # the updated clip stays replicated.
+            c = self.config.fed
+            bnoise = (
+                self.dp_bit_noise
+                * jax.random.normal(prng.clip_bit_key(key, round_idx), ())
+                if self.dp_bit_noise > 0.0 else 0.0
+            )
+            frac = jnp.clip(
+                (bit_sum + bnoise)
+                / jnp.maximum(n_comp.astype(jnp.float32), 1.0),
+                0.0, 1.0,
+            )
+            new_clip = dp_lib.adaptive_clip_update(
+                clip, frac, c.dp_target_quantile, c.dp_clip_lr
+            )
+            # A zero-contributor round (all stragglers) carries no norm
+            # evidence: freeze the clip like the server update freezes.
+            new_clip = jnp.where(n_comp > 0, new_clip, clip)
+            metrics["dp_clip"] = jnp.maximum(new_clip, 1e-6)
+            metrics["dp_bit_frac"] = frac
         return new_state, metrics
 
     def _manual_axes(self) -> frozenset:
@@ -526,7 +597,7 @@ class FederatedLearner:
             self.cohort_size_local = self.cohort_size
 
             def round_fn(server_state, key, round_idx, x, y, counts, ids,
-                         sel_in, c_cohort):
+                         sel_in, c_cohort, clip_in):
                 if self.scaffold:
                     # Cohort-resident variates: the cohort was sampled on
                     # host (so its variate rows could be gathered) and
@@ -539,17 +610,21 @@ class FederatedLearner:
                     else:
                         sel = jnp.arange(self.num_clients)
                 cohort_global = jnp.take(ids, sel)
-                wsum, total_w, (loss_sum, n_comp), extras = self._cohort_step(
-                    server_state.params, sel, cohort_global, cohort_global,
-                    x, y, counts, key, round_idx,
-                    control=server_state.control, c_blk=c_cohort,
+                wsum, total_w, (loss_sum, n_comp, bit_sum), extras = (
+                    self._cohort_step(
+                        server_state.params, sel, cohort_global,
+                        cohort_global, x, y, counts, key, round_idx,
+                        control=server_state.control, c_blk=c_cohort,
+                        clip=clip_in,
+                    )
                 )
                 dc_sum, n_contrib, new_c = (
                     extras if extras is not None else (None, None, None)
                 )
                 new_state, metrics = self._finish_round(
                     server_state, wsum, total_w, loss_sum, n_comp,
-                    dc_sum=dc_sum, n_contrib=n_contrib,
+                    dc_sum=dc_sum, n_contrib=n_contrib, bit_sum=bit_sum,
+                    clip=clip_in, key=key, round_idx=round_idx,
                 )
                 return new_state, metrics, new_c
 
@@ -564,7 +639,7 @@ class FederatedLearner:
         local_clients = self.num_clients // self.clients_size
 
         def body(server_state, key, round_idx, x_blk, y_blk, counts_blk,
-                 ids_blk, sel_blk, c_blk):
+                 ids_blk, sel_blk, c_blk, clip_in):
             if self.scaffold:
                 sel = sel_blk            # host-sampled (cohort-resident c)
             else:
@@ -583,16 +658,19 @@ class FederatedLearner:
             # Secure-agg masks pair against the FULL mesh-wide cohort: a
             # cheap all_gather of the (cohort_per_device,) id vectors.
             mask_cohort = jax.lax.all_gather(cohort_global, ax).reshape(-1)
-            wsum, total_w, (loss_sum, n_comp), extras = self._cohort_step(
-                server_state.params, sel, cohort_global, mask_cohort,
-                x_blk, y_blk, counts_blk, key, round_idx,
-                control=server_state.control, c_blk=c_blk,
+            wsum, total_w, (loss_sum, n_comp, bit_sum), extras = (
+                self._cohort_step(
+                    server_state.params, sel, cohort_global, mask_cohort,
+                    x_blk, y_blk, counts_blk, key, round_idx,
+                    control=server_state.control, c_blk=c_blk, clip=clip_in,
+                )
             )
             # FedAvg across the pod: one psum over ICI per leaf.
             wsum = jax.tree.map(lambda l: jax.lax.psum(l, ax), wsum)
             total_w = jax.lax.psum(total_w, ax)
             loss_sum = jax.lax.psum(loss_sum, ax)
             n_comp = jax.lax.psum(n_comp, ax)
+            bit_sum = jax.lax.psum(bit_sum, ax)
             if extras is not None:
                 dc_sum, n_contrib, new_c = extras
                 dc_sum = jax.tree.map(lambda l: jax.lax.psum(l, ax), dc_sum)
@@ -601,7 +679,8 @@ class FederatedLearner:
                 dc_sum, n_contrib, new_c = None, None, None
             new_state, metrics = self._finish_round(
                 server_state, wsum, total_w, loss_sum, n_comp,
-                dc_sum=dc_sum, n_contrib=n_contrib,
+                dc_sum=dc_sum, n_contrib=n_contrib, bit_sum=bit_sum,
+                clip=clip_in, key=key, round_idx=round_idx,
             )
             return new_state, metrics, new_c
 
@@ -612,7 +691,7 @@ class FederatedLearner:
             body,
             mesh=mesh,
             in_specs=(P(), P(), P(), x_spec, P(ax), P(ax), P(ax), sel_spec,
-                      c_spec),
+                      c_spec, P()),
             out_specs=(P(), P(), c_spec),
             axis_names=self._manual_axes(),
             check_vma=False,
@@ -702,7 +781,12 @@ class FederatedLearner:
             *self._device_data,
             sel_dev,
             c_cohort,
+            self._dp_clip,
         )
+        if self.adaptive_clip:
+            # Feed the adapted clip into the next round as a device scalar
+            # (no host round-trip; sync=False rounds keep pipelining).
+            self._dp_clip = metrics["dp_clip"]
         if self.scaffold:
             updated = jax.tree.map(np.asarray, new_c)
 
@@ -854,6 +938,10 @@ class FederatedLearner:
         if self.accountant is not None:
             # ε must account for every round already spent before the kill.
             self.accountant.steps = step
+        if self.adaptive_clip and history:
+            # The clip state rides the per-round metrics (one scalar per
+            # record), so resume continues from the adapted norm.
+            self._dp_clip = jnp.float32(history[-1]["dp_clip"])
         return step
 
     def fit(self, rounds: Optional[int] = None, log_fn=None) -> list[dict]:

@@ -140,6 +140,12 @@ def finalize_client_delta(
     delta = result.delta
     weight = float(result.num_examples)
     c = config.fed
+    if c.dp_adaptive_clip:
+        raise NotImplementedError(
+            "dp_adaptive_clip is engine-only: the clip norm is cross-round "
+            "server state the stateless file/socket participants don't "
+            "carry; use the on-device simulation or a fixed dp_clip"
+        )
     if c.dp_clip > 0.0:
         key = prng.experiment_key(config.run.seed)
         delta = dp_lib.clip_and_noise(

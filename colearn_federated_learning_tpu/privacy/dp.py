@@ -35,12 +35,50 @@ def add_gaussian_noise(delta, std, key: jax.Array):
     return jax.tree.unflatten(treedef, noised)
 
 
-def clip_and_noise(delta, clip: float, noise_multiplier: float, cohort_size: int,
+def clip_and_noise(delta, clip, noise_multiplier: float, cohort_size: int,
                    key: jax.Array):
     """Per-client DP hook: clip to ``clip``, noise for central std
-    ``clip * noise_multiplier`` after summing ``cohort_size`` clients."""
+    ``clip * noise_multiplier`` after summing ``cohort_size`` clients.
+    ``clip`` may be a traced scalar (adaptive clipping)."""
     delta, _ = clip_by_global_norm(delta, clip)
     if noise_multiplier > 0.0:
         std = clip * noise_multiplier / jnp.sqrt(float(max(cohort_size, 1)))
         delta = add_gaussian_noise(delta, std, key)
     return delta
+
+
+def clip_and_noise_with_bit(delta, clip, noise_multiplier: float,
+                            cohort_size: int, key: jax.Array):
+    """Adaptive-clipping variant: also returns the quantile bit
+    ``b = 1{‖Δ‖ ≤ clip}`` computed on the PRE-clip norm (Andrew et al.
+    1905.03871, pattern only — the per-round geometric clip update lives in
+    the engine's round epilogue)."""
+    clipped, norm = clip_by_global_norm(delta, clip)
+    if noise_multiplier > 0.0:
+        std = clip * noise_multiplier / jnp.sqrt(float(max(cohort_size, 1)))
+        clipped = add_gaussian_noise(clipped, std, key)
+    return clipped, (norm <= clip).astype(jnp.float32)
+
+
+def adaptive_noise_multiplier(z: float, bit_noise: float) -> float:
+    """Update-noise multiplier z_Δ such that (update, bit) JOINTLY cost the
+    configured total multiplier ``z``: z_Δ = (z⁻² − (2σ_b)⁻²)^(−1/2)
+    (Andrew et al. — the bit query has sensitivity 1 = 2·(1/2), hence the
+    2σ_b).  Requires z < 2σ_b; the RDP accountant can then keep charging
+    the single-mechanism rate z per round."""
+    if z <= 0.0:
+        return 0.0
+    if 2.0 * bit_noise <= z:
+        raise ValueError(
+            f"adaptive clipping needs bit_noise > z/2 (z={z}, "
+            f"bit_noise={bit_noise}); raise dp_bit_noise"
+        )
+    return (z ** -2 - (2.0 * bit_noise) ** -2) ** -0.5
+
+
+def adaptive_clip_update(clip, bit_frac, target_quantile: float,
+                         clip_lr: float):
+    """Geometric clip-norm step toward the target quantile:
+    C ← C · exp(−η_C (b̃ − γ)).  Pure jnp — runs inside the round program,
+    so the clip state stays a device scalar across rounds."""
+    return clip * jnp.exp(-clip_lr * (bit_frac - target_quantile))

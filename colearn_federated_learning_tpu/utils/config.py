@@ -65,6 +65,12 @@ class FedConfig:
     dp_clip: float = 0.0              # 0 disables clipping
     dp_noise_multiplier: float = 0.0  # Gaussian sigma = mult * clip
     dp_delta: float = 1e-5            # δ at which the accountant reports ε
+    # Adaptive clipping (quantile tracking; privacy/dp.py): dp_clip becomes
+    # the INITIAL clip and follows the dp_target_quantile of update norms.
+    dp_adaptive_clip: bool = False
+    dp_target_quantile: float = 0.5
+    dp_clip_lr: float = 0.2           # η_C of the geometric clip update
+    dp_bit_noise: float = 0.0         # σ_b on the bit sum; 0 = cohort/20
     secure_agg: bool = False
     secure_agg_neighbors: int = 0     # 0 = all-pairs masks; k = random ring
     # Update compression on the wire/file planes (fed/compression.py).

@@ -26,6 +26,7 @@ _TAG_STRAGGLER = 0x5
 _TAG_INIT = 0x6
 _TAG_DATA = 0x7
 _TAG_MASK_RING = 0x8
+_TAG_CLIP_BIT = 0x9
 
 
 def experiment_key(seed: int) -> jax.Array:
@@ -84,6 +85,12 @@ def pair_mask_key(key: jax.Array, client_a, client_b, round_idx) -> jax.Array:
 def straggler_key(key: jax.Array, round_idx) -> jax.Array:
     """Key for simulated straggler step budgets in one round."""
     return _derive(key, _TAG_STRAGGLER, round_idx)
+
+
+def clip_bit_key(key: jax.Array, round_idx) -> jax.Array:
+    """Key for the DP noise on the adaptive-clipping bit aggregate
+    (privacy/dp.py adaptive quantile tracking) in one round."""
+    return _derive(key, _TAG_CLIP_BIT, round_idx)
 
 
 def mask_ring_key(key: jax.Array) -> jax.Array:
