@@ -153,6 +153,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                 k: (v.tolist() if hasattr(v, "tolist") else v)
                 for k, v in rep.items()
             }), file=sys.stderr)
+        if args.personalize_steps:
+            rep = learner.evaluate_personalized(steps=args.personalize_steps)
+            print(json.dumps({
+                k: (v.tolist() if hasattr(v, "tolist") else v)
+                for k, v in rep.items()
+            }), file=sys.stderr)
         samples = (learner.cohort_size * learner.num_steps
                    * config.fed.batch_size)
         n_chips = learner.mesh.devices.size if learner.mesh is not None else 1
@@ -295,6 +301,10 @@ def main(argv: list[str] | None = None) -> int:
     p_train.add_argument("--resume", action="store_true")
     p_train.add_argument("--per-client-eval", action="store_true",
                          help="report per-client accuracy spread at the end")
+    p_train.add_argument("--personalize-steps", type=int, default=0,
+                         help="fine-tune-then-eval personalization probe: "
+                              "N local SGD steps per client on half its "
+                              "shard, scored on the held-out half")
     p_train.set_defaults(fn=cmd_train)
 
     p_init = sub.add_parser("init", help="write an initial global model file")

@@ -864,6 +864,131 @@ class FederatedLearner:
             "acc_p90": float(np.percentile(acc, 90)),
         }
 
+    # ---- personalized evaluation (fine-tune-then-eval) ----------------
+    def evaluate_personalized(self, steps: int = 5,
+                              lr: Optional[float] = None) -> dict:
+        """Per-client personalization probe: fine-tune the CURRENT global
+        model on the first half of each client's shard for ``steps`` local
+        SGD steps, then score BOTH the global and the personalized model on
+        the held-out second half.  The spread between the two is the value
+        personalization adds under this partition — the FedPer-style
+        question the reference cannot ask (its evaluator scores one global
+        holdout).  One jit program, vmapped over clients (sharded over the
+        client axis on a mesh).
+
+        Clients with fewer than 2 examples have no holdout half and are
+        dropped from the aggregates.
+        """
+        key = (steps, lr)
+        if getattr(self, "_pers_eval_key", None) != key:
+            self._pers_eval_fn = self._build_personalized_eval_fn(
+                steps, lr if lr is not None else self.config.fed.lr
+            )
+            self._pers_eval_key = key
+        g_acc, p_acc, n_eval = self._pers_eval_fn(
+            self.server_state.params, *self._device_data
+        )
+        g_acc, p_acc = np.asarray(g_acc), np.asarray(p_acc)
+        n_eval = np.asarray(n_eval)
+        order = np.argsort(self.client_ids, kind="stable")
+        g_acc, p_acc, n_eval = g_acc[order], p_acc[order], n_eval[order]
+        real = n_eval > 0
+        g_acc, p_acc, n_eval = g_acc[real], p_acc[real], n_eval[real]
+        if n_eval.sum() == 0:
+            # No client holds the >= 2 examples a holdout half needs.
+            return {
+                "global_acc": 0.0, "personalized_acc": 0.0,
+                "personalization_gain": 0.0,
+                "per_client_global_acc": g_acc,
+                "per_client_personalized_acc": p_acc,
+                "num_eval_examples": n_eval,
+                "num_clients_evaluated": 0,
+            }
+        w = n_eval / n_eval.sum()
+        return {
+            "global_acc": float((g_acc * w).sum()),
+            "personalized_acc": float((p_acc * w).sum()),
+            "personalization_gain": float(((p_acc - g_acc) * w).sum()),
+            "per_client_global_acc": g_acc,
+            "per_client_personalized_acc": p_acc,
+            "num_eval_examples": n_eval,
+            "num_clients_evaluated": int(real.sum()),
+        }
+
+    def _build_personalized_eval_fn(self, steps: int, lr: float):
+        import dataclasses
+
+        c = self.config
+        apply_fn = (self.model if self.sp else self.eval_model).apply
+        # The fine-tune is the CONFIG's local trainer (same optimizer,
+        # momentum, MoE aux loss, prox term) with the step budget and lr
+        # overridden — setup_lib keeps the wiring identical to training.
+        ft_config = c.replace(fed=dataclasses.replace(
+            c.fed,
+            strategy=c.fed.strategy if c.fed.strategy == "fedprox" else "fedavg",
+            local_steps=steps, lr=lr, straggler_prob=0.0,
+        ))
+        update, _ = setup_lib.local_trainer_for_config(
+            ft_config, apply_fn, self.shards.capacity,
+            grad_sync_axes=(self.seq_axis,) if self.sp else (),
+        )
+        budget = jnp.asarray(steps, jnp.int32)
+        batch = max(c.fed.batch_size, 64)
+        cap = self.shards.capacity
+        n_chunks = int(np.ceil(cap / batch))
+        padded = n_chunks * batch
+
+        def score(params, cx, cy, lo, hi):
+            """Mean accuracy over shard rows [lo, hi), scanned in
+            batch-sized chunks (bounded activation memory, same scheme as
+            _build_client_eval_fn)."""
+            pad = padded - cap
+            cxp = jnp.concatenate(
+                [cx, jnp.zeros((pad,) + cx.shape[1:], cx.dtype)]
+            ) if pad else cx
+            cyp = jnp.concatenate([cy, jnp.zeros((pad,), cy.dtype)]) if pad else cy
+            xb = cxp.reshape((n_chunks, batch) + cx.shape[1:])
+            yb = cyp.reshape((n_chunks, batch))
+            base = jnp.arange(n_chunks) * batch
+
+            def chunk(carry, inp):
+                x_, y_, b = inp
+                logits = apply_fn({"params": params}, x_, train=False)
+                correct = (jnp.argmax(logits, axis=-1) == y_).astype(jnp.float32)
+                rows = b + jnp.arange(batch)
+                m = ((rows >= lo) & (rows < hi)).astype(jnp.float32)
+                a, n = carry
+                return (a + jnp.sum(correct * m), n + jnp.sum(m)), None
+
+            (a, n), _ = jax.lax.scan(chunk, (0.0, 0.0), (xb, yb, base))
+            return a / jnp.maximum(n, 1.0)
+
+        def one_client(params, cx, cy, count, gid):
+            n_ft = count // 2                       # fine-tune half
+            n_eval = jnp.where(count >= 2, count - n_ft, 0)
+            # Purpose-distinct key: round index past any training round.
+            key = prng.client_round_key(
+                self.base_key, gid, jnp.asarray(1 << 24, jnp.int32)
+            )
+            res = update(params, cx, cy, jnp.maximum(n_ft, 1), key, budget)
+            pers = pytrees.tree_add(params, res.delta)
+            g_acc = score(params, cx, cy, n_ft, count)
+            p_acc = score(pers, cx, cy, n_ft, count)
+            return g_acc, p_acc, n_eval
+
+        vmapped = jax.vmap(one_client, in_axes=(None, 0, 0, 0, 0))
+        if self.mesh is None:
+            return jax.jit(vmapped)
+        ax = self.client_axis
+        x_spec = P(ax, None, self.seq_axis) if self.sp else P(ax)
+        return jax.jit(shard_map(
+            vmapped, mesh=self.mesh,
+            in_specs=(P(), x_spec, P(ax), P(ax), P(ax)),
+            out_specs=(P(ax), P(ax), P(ax)),
+            axis_names=self._manual_axes(),
+            check_vma=False,
+        ))
+
     def _build_client_eval_fn(self):
         batch = max(self.config.fed.batch_size, 64)
         cap = self.shards.capacity

@@ -61,6 +61,19 @@ def scaled_variants():
     out["agnews_bert_fedavg"] = (
         c, "BERT scaled 768x12 -> 256x4 (single-chip budget); lr 1e-4")
 
+    # Not a BASELINE config — the MoE family is a rebuild superset; its
+    # curve documents that the expert-parallel path LEARNS, not just runs.
+    c = get_config("agnews_bert_fedavg")
+    c = c.replace(
+        model=dataclasses.replace(c.model, name="moe_bert", width=256,
+                                  depth=4, num_heads=8, num_experts=4),
+        data=dataclasses.replace(c.data, max_examples_per_client=256),
+        fed=dataclasses.replace(c.fed, rounds=20, lr=1e-4),
+    )
+    c = c.replace(run=dataclasses.replace(c.run, name="agnews_moebert"))
+    out["agnews_moebert_fedavg"] = (
+        c, "MoE superset: 4 experts every other block, top-2 routing")
+
     c = get_config("femnist_vit_cross_silo")
     c = c.replace(
         model=dataclasses.replace(c.model, width=192, depth=4, num_heads=3,

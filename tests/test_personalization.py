@@ -1,0 +1,62 @@
+"""Personalized evaluation (engine.evaluate_personalized).
+
+FedPer-style probe the reference cannot ask: fine-tune the global model on
+half of each client's shard, score global vs personalized on the held-out
+half.  Under a strongly non-IID Dirichlet partition the personalized model
+must beat the global one on the clients' own distributions.
+"""
+
+import numpy as np
+from jax.sharding import Mesh
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def _cfg():
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=8,
+                        partition="dirichlet", dirichlet_alpha=0.1,
+                        max_examples_per_client=64),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=3, cohort_size=0,
+                      local_steps=3, batch_size=16, lr=0.1, momentum=0.9),
+        run=RunConfig(name="pers_test"),
+    )
+
+
+def test_personalization_gains_under_non_iid():
+    learner = FederatedLearner(_cfg())
+    learner.fit(rounds=3)
+    rep = learner.evaluate_personalized(steps=10)
+    # Sanity: per-client arrays align and weights come from real clients.
+    n = len(rep["per_client_global_acc"])
+    assert n == len(rep["per_client_personalized_acc"]) == 8
+    assert (rep["num_eval_examples"] > 0).all()
+    # α=0.1 partitions are nearly single-class per client: a few local
+    # steps on the client's own half must beat the global model there.
+    assert rep["personalized_acc"] > rep["global_acc"]
+    assert rep["personalization_gain"] > 0.02, rep["personalization_gain"]
+
+
+def test_personalization_mesh_matches_single_device(cpu_devices):
+    cfg = _cfg()
+    ref = FederatedLearner(cfg)
+    ref.fit(rounds=2)
+    rep_ref = ref.evaluate_personalized(steps=4)
+
+    mesh = Mesh(np.array(cpu_devices[:8]), ("clients",))
+    m = FederatedLearner(cfg, mesh=mesh)
+    m.fit(rounds=2)
+    rep_m = m.evaluate_personalized(steps=4)
+    np.testing.assert_allclose(rep_m["per_client_global_acc"],
+                               rep_ref["per_client_global_acc"], atol=1e-6)
+    np.testing.assert_allclose(rep_m["per_client_personalized_acc"],
+                               rep_ref["per_client_personalized_acc"],
+                               atol=1e-5)
