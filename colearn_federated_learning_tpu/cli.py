@@ -45,6 +45,10 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--strategy", default=None,
                    choices=["fedavg", "fedprox", "fedadam", "fedyogi", "scaffold"])
     p.add_argument("--prox-mu", type=float, default=None)
+    p.add_argument("--aggregator", default=None,
+                   choices=["mean", "median", "trimmed_mean"],
+                   help="Byzantine-robust server aggregation (fed/robust.py)")
+    p.add_argument("--trim-fraction", type=float, default=None)
     p.add_argument("--dataset", default=None)
     p.add_argument("--partition", default=None, choices=["iid", "dirichlet"])
     p.add_argument("--dirichlet-alpha", type=float, default=None)
@@ -82,7 +86,7 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "prox_mu", "dp_clip", "dp_noise_multiplier", "dp_delta",
              "dp_adaptive_clip", "dp_target_quantile", "dp_clip_lr",
              "dp_bit_noise", "secure_agg", "secure_agg_neighbors",
-             "straggler_prob", "compress"}
+             "straggler_prob", "compress", "aggregator", "trim_fraction"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}

@@ -69,6 +69,7 @@ class AsyncFederatedCoordinator:
                 "and no async accountant is implemented; use the "
                 "synchronous coordinator for DP runs"
             )
+        setup_lib.require_mean_aggregator(config, "the async coordinator")
         self.config = config
         self.buffer_size = buffer_size
         self.staleness_exponent = staleness_exponent

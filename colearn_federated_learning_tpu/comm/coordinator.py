@@ -45,6 +45,7 @@ class FederatedCoordinator:
         round_timeout: float = 60.0,
         want_evaluator: bool = True,
     ):
+        setup_lib.require_mean_aggregator(config, "the socket coordinator")
         self.config = config
         self.round_timeout = round_timeout
         self.want_evaluator = want_evaluator

@@ -271,6 +271,36 @@ class FederatedLearner:
                 "host-resident variate store is unsharded and the per-round "
                 "gather/scatter would funnel TP shards through one host"
             )
+        # Byzantine-robust aggregation (fed/robust.py).
+        from colearn_federated_learning_tpu.fed.robust import AGGREGATORS
+
+        if c.fed.aggregator not in AGGREGATORS:
+            raise ValueError(
+                f"unknown aggregator {c.fed.aggregator!r}; use {AGGREGATORS}"
+            )
+        self.robust = c.fed.aggregator != "mean"
+        if self.robust:
+            if not 0.0 <= c.fed.trim_fraction < 0.5:
+                raise ValueError(
+                    "trim_fraction must be in [0, 0.5), got "
+                    f"{c.fed.trim_fraction}"
+                )
+            if c.fed.secure_agg:
+                raise ValueError(
+                    "robust aggregators need the individual updates; "
+                    "secure-agg masks only cancel in a plain sum"
+                )
+            if self.scaffold:
+                raise ValueError(
+                    "scaffold assumes mean aggregation of its control "
+                    "variates; use aggregator='mean'"
+                )
+            if c.fed.dp_noise_multiplier > 0.0:
+                raise ValueError(
+                    "robust aggregation of noised updates is not the "
+                    "Gaussian mechanism the RDP accountant models; use "
+                    "dp_clip alone (norm bounding) with robust aggregators"
+                )
         self.local_update, self.num_steps = setup_lib.local_trainer_for_config(
             c, self.model.apply, shards.capacity,
             grad_sync_axes=(self.seq_axis,) if self.sp else (),
@@ -443,7 +473,8 @@ class FederatedLearner:
 
         # SCAFFOLD averages uniformly over the sampled cohort (the variate
         # algebra assumes it); DP/secure-agg force uniform weights too.
-        uniform_weights = c.dp_clip > 0.0 or c.secure_agg or self.scaffold
+        uniform_weights = (c.dp_clip > 0.0 or c.secure_agg or self.scaffold
+                           or self.robust)
         bits = None
         if c.dp_clip > 0.0:
             dp_keys = jax.vmap(lambda i: prng.dp_key(key, i, round_idx))(global_ids)
@@ -463,10 +494,13 @@ class FederatedLearner:
                 )(deltas, dp_keys)
 
         nonghost = (results.num_examples > 0)
+        # The ONE contributor mask (real, non-straggler) every aggregation
+        # branch and metric below derives from.
+        contrib = completed & nonghost
         if uniform_weights:
-            weights = (completed & nonghost).astype(jnp.float32)
+            weights = contrib.astype(jnp.float32)
         else:
-            weights = results.num_examples.astype(jnp.float32) * (completed & nonghost)
+            weights = results.num_examples.astype(jnp.float32) * contrib
 
         if c.secure_agg:
             # Clients pre-scale by their weight, then add pairwise masks;
@@ -486,6 +520,28 @@ class FederatedLearner:
                                                      round_idx)
             )(wdeltas, global_ids, partners)
             wsum = jax.tree.map(lambda l: jnp.sum(l, axis=0), masked)
+        elif self.robust:
+            # Coordinate-wise robust statistic over the FULL cohort
+            # (fed/robust.py).  Order statistics are not psum-decomposable,
+            # so on a mesh the stacked deltas are all-gathered over the
+            # client axis first and the aggregate comes out replicated —
+            # the round epilogue uses it directly (no psum, no division).
+            from colearn_federated_learning_tpu.fed.robust import (
+                robust_aggregate,
+            )
+
+            if self.mesh is not None:
+                ax = self.client_axis
+                all_deltas = jax.tree.map(
+                    lambda l: jax.lax.all_gather(l, ax, axis=0, tiled=True),
+                    deltas,
+                )
+                all_contrib = jax.lax.all_gather(contrib, ax, axis=0,
+                                                 tiled=True)
+            else:
+                all_deltas, all_contrib = deltas, contrib
+            wsum = robust_aggregate(all_deltas, all_contrib,
+                                    c.aggregator, c.trim_fraction)
         else:
             wsum = pytrees.tree_weighted_sum(deltas, weights)
 
@@ -493,7 +549,6 @@ class FederatedLearner:
         loss_sum = jnp.sum(results.mean_loss * weights)
         # "completed" reports real contributors only (ghost padding slots
         # always finish their budget but never contribute).
-        contrib = completed & nonghost
         n_completed = jnp.sum(contrib.astype(jnp.int32))
         # Quantile-bit sum over CONTRIBUTORS (the clip adapts to the norms
         # that actually entered the aggregate).
@@ -526,9 +581,14 @@ class FederatedLearner:
         update; the explicit gate matters under secure_agg, where wsum is
         not exactly zero but the float32 mask-cancellation residual."""
         denom = jnp.where(total_w > 0, total_w, 1.0)
-        mean_delta = pytrees.tree_scale(
-            wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
-        )
+        if self.robust:
+            # wsum IS the robust aggregate (zero when nobody contributed);
+            # total_w only normalizes the loss metric below.
+            mean_delta = wsum
+        else:
+            mean_delta = pytrees.tree_scale(
+                wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
+            )
         mean_delta_c = participation = None
         if self.scaffold:
             safe_n = jnp.maximum(n_contrib, 1.0)
@@ -665,8 +725,10 @@ class FederatedLearner:
                     control=server_state.control, c_blk=c_blk, clip=clip_in,
                 )
             )
-            # FedAvg across the pod: one psum over ICI per leaf.
-            wsum = jax.tree.map(lambda l: jax.lax.psum(l, ax), wsum)
+            # FedAvg across the pod: one psum over ICI per leaf.  (Robust
+            # aggregates are already global+replicated — no psum.)
+            if not self.robust:
+                wsum = jax.tree.map(lambda l: jax.lax.psum(l, ax), wsum)
             total_w = jax.lax.psum(total_w, ax)
             loss_sum = jax.lax.psum(loss_sum, ax)
             n_comp = jax.lax.psum(n_comp, ax)

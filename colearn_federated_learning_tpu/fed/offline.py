@@ -104,6 +104,7 @@ def aggregate_updates(
     using the configured server strategy (fed/strategies.py)."""
     if not update_paths:
         raise ValueError("aggregate_updates: no update files given")
+    setup_lib.require_mean_aggregator(config, "the file-based aggregator")
     params, meta = load_pytree_npz(global_path)
     round_idx = int(meta.get("round", 0))
 

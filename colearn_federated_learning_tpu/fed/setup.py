@@ -107,6 +107,21 @@ def require_stateless_strategy(config: ExperimentConfig, where: str) -> None:
         )
 
 
+def require_mean_aggregator(config: ExperimentConfig, where: str) -> None:
+    """The file/socket aggregation planes fold updates incrementally
+    (comm/aggregation.py, fed/offline.py) — coordinate-wise order
+    statistics need ALL updates at once, so robust aggregators are
+    engine-only.  Silently averaging when the config asks for 'median'
+    would defeat the whole point; be loud instead."""
+    if config.fed.aggregator != "mean":
+        raise NotImplementedError(
+            f"{where} does not support aggregator="
+            f"{config.fed.aggregator!r} (robust aggregation is "
+            "engine-only); use the on-device simulation or aggregator="
+            "'mean'"
+        )
+
+
 def init_global_params(config: ExperimentConfig) -> Any:
     """Seed-deterministic global model init (shared by the file-based and
     socket-based federation entrypoints, so every participant derives the

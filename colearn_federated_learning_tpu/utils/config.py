@@ -53,6 +53,10 @@ class FedConfig:
     local_optimizer: str = "sgd"      # sgd | adam | adamw (client-side)
     prox_mu: float = 0.0              # FedProx μ (BASELINE config #3: 0.01)
     server_lr: float = 1.0            # server-side step on the mean delta
+    # Byzantine-robust aggregation (fed/robust.py): replaces the weighted
+    # mean with a coordinate-wise order statistic over the cohort.
+    aggregator: str = "mean"          # mean | median | trimmed_mean
+    trim_fraction: float = 0.1        # per-side trim for trimmed_mean
     server_beta1: float = 0.9         # FedAdam/FedYogi
     server_beta2: float = 0.99
     server_eps: float = 1e-3

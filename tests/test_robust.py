@@ -1,0 +1,142 @@
+"""Byzantine-robust aggregation (fed/robust.py + engine wiring).
+
+The reference's mean aggregator lets one malicious IoT device steer the
+global model arbitrarily; the rebuild adds coordinate-wise median and
+trimmed mean.  Tests: statistics vs numpy oracles (with masking), a
+label-flip poisoning attack the median survives and the mean does not,
+and mesh/vmap equivalence.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.fed.robust import robust_aggregate
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def test_robust_statistics_match_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(9, 4, 3)).astype(np.float32)
+    mask = np.array([1, 1, 1, 0, 1, 1, 0, 1, 1], bool)   # 7 contributors
+    tree = {"a": jnp.asarray(x), "b": jnp.asarray(x[:, 0])}
+
+    med = robust_aggregate(tree, jnp.asarray(mask), "median")
+    np.testing.assert_allclose(np.asarray(med["a"]),
+                               np.median(x[mask], axis=0), atol=1e-6)
+
+    tm = robust_aggregate(tree, jnp.asarray(mask), "trimmed_mean",
+                          trim_fraction=0.2)
+    k = int(np.floor(0.2 * mask.sum()))                  # 1 per side
+    ref = np.sort(x[mask], axis=0)[k:mask.sum() - k].mean(axis=0)
+    np.testing.assert_allclose(np.asarray(tm["a"]), ref, atol=1e-6)
+
+    # No contributors -> zeros, not NaN.
+    zed = robust_aggregate(tree, jnp.zeros(9, bool), "median")
+    assert float(np.abs(np.asarray(zed["a"])).max()) == 0.0
+
+
+def _cfg(aggregator="mean", num_clients=8):
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=num_clients,
+                        partition="iid", max_examples_per_client=64),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=5, cohort_size=0,
+                      local_steps=3, batch_size=16, lr=0.1, momentum=0.9,
+                      aggregator=aggregator),
+        run=RunConfig(name=f"robust_{aggregator}"),
+    )
+
+
+class _LabelFlipLearner(FederatedLearner):
+    """Flip the labels of the first ``n_bad`` clients AFTER partitioning —
+    a classic data-poisoning attacker inside the simulation."""
+
+    def __init__(self, config, n_bad: int, **kw):
+        self._n_bad = n_bad
+        super().__init__(config, **kw)
+        x, y, counts, ids = self._device_data
+        yh = np.array(y)                              # writable copy
+        bad = np.isin(np.asarray(self.client_ids), np.arange(n_bad))
+        yh[bad] = (9 - yh[bad]) % 10                  # deterministic flip
+        y = jnp.asarray(yh)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            y = jax.device_put(
+                y, NamedSharding(self.mesh, P(self.client_axis))
+            )
+        self._device_data = (x, y, counts, ids)
+
+
+def test_median_survives_label_flip_poisoning():
+    # 3 of 8 clients flip every label.  The mean aggregator degrades badly;
+    # the coordinate-wise median keeps learning.  (Measured on this seed:
+    # mean 0.665, median 0.857 after 8 rounds.)
+    mean_l = _LabelFlipLearner(_cfg("mean"), n_bad=3)
+    mean_l.fit(rounds=8)
+    _, acc_mean = mean_l.evaluate()
+
+    med_l = _LabelFlipLearner(_cfg("median"), n_bad=3)
+    med_l.fit(rounds=8)
+    _, acc_med = med_l.evaluate()
+
+    assert acc_med > 0.8, acc_med
+    assert acc_med > acc_mean + 0.1, (acc_med, acc_mean)
+
+    # Trimmed mean needs trim >= attacker fraction to help: with 3/8
+    # attackers, trim 0.4 trims 3 per side; 0.1 trims none (k = 0).
+    tm_cfg = _cfg("trimmed_mean")
+    tm_cfg = tm_cfg.replace(
+        fed=dataclasses.replace(tm_cfg.fed, trim_fraction=0.4))
+    tm_l = _LabelFlipLearner(tm_cfg, n_bad=3)
+    tm_l.fit(rounds=8)
+    _, acc_tm = tm_l.evaluate()
+    assert acc_tm > acc_mean + 0.1, (acc_tm, acc_mean)
+
+
+def test_trimmed_mean_learns_clean():
+    learner = FederatedLearner(_cfg("trimmed_mean"))
+    learner.fit(rounds=8)
+    _, acc = learner.evaluate()
+    assert acc > 0.85, acc
+
+
+def test_robust_mesh_matches_vmap(cpu_devices):
+    from jax.sharding import Mesh
+
+    cfg = _cfg("median")
+    ref = FederatedLearner(cfg)
+    mesh = Mesh(np.array(cpu_devices[:8]), ("clients",))
+    m = FederatedLearner(cfg, mesh=mesh)
+    for _ in range(2):
+        r_ref = ref.run_round()
+        r_m = m.run_round()
+    np.testing.assert_allclose(r_m["train_loss"], r_ref["train_loss"],
+                               rtol=1e-5)
+    p1 = np.concatenate([np.ravel(np.asarray(a))
+                         for a in jax.tree.leaves(m.server_state.params)])
+    p2 = np.concatenate([np.ravel(np.asarray(a))
+                         for a in jax.tree.leaves(ref.server_state.params)])
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_robust_guards():
+    with pytest.raises(ValueError, match="secure-agg"):
+        FederatedLearner(_cfg("median").replace(
+            fed=dataclasses.replace(_cfg("median").fed, secure_agg=True)))
+    with pytest.raises(ValueError, match="Gaussian"):
+        FederatedLearner(_cfg("median").replace(
+            fed=dataclasses.replace(_cfg("median").fed, dp_clip=1.0,
+                                    dp_noise_multiplier=0.5)))
+
