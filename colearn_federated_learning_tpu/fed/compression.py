@@ -61,13 +61,16 @@ def compress_delta(delta: Any, scheme: str) -> tuple[Any, dict]:
 
         return jax.tree.map(q, delta), {"compress": "int8"}
     if scheme == "topk":
+        from colearn_federated_learning_tpu import native
+
         def k_of(leaf):
             flat = np.asarray(leaf, np.float32).ravel()
             # Keep at least one entry so tiny biases/scalars survive.
             k = max(1, int(np.ceil(flat.size * TOPK_FRACTION)))
-            idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
-            idx = np.sort(idx).astype(np.int32)
-            return {_I: idx, _V: flat[idx], _N: np.int64(flat.size)}
+            # Thread-parallel selection when the C++ library is present
+            # (native/src/topk.cpp); numpy argpartition otherwise.
+            idx, val = native.topk_abs(flat, k)
+            return {_I: idx, _V: val, _N: np.int64(flat.size)}
 
         return jax.tree.map(k_of, delta), {"compress": "topk"}
     raise ValueError(f"unknown compression {scheme!r} (use {SCHEMES})")

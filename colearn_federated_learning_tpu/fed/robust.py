@@ -37,8 +37,10 @@ def _median_leaf(xs: jax.Array, n_valid: jax.Array) -> jax.Array:
 
 def _trimmed_leaf(xs: jax.Array, n_valid: jax.Array,
                   trim_fraction: float) -> jax.Array:
-    """Mean of the sorted rows [k, n_valid - k), k = floor(trim·n_valid)."""
-    k = jnp.floor(trim_fraction * n_valid).astype(jnp.int32)
+    """Mean of the sorted rows [k, n_valid - k), k = floor(trim·n_valid).
+    The epsilon guards float32 products that are exactly integral in
+    exact arithmetic (e.g. 0.45 · 20) from rounding DOWN a trim."""
+    k = jnp.floor(trim_fraction * n_valid + 1e-4).astype(jnp.int32)
     idx = jnp.arange(xs.shape[0])
     sel = (idx >= k) & (idx < n_valid - k)
     selb = sel.reshape((-1,) + (1,) * (xs.ndim - 1))

@@ -66,10 +66,40 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_int32,
             ]
+            lib.cl_topk_abs.restype = ctypes.c_int
+            lib.cl_topk_abs.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ]
             _lib = lib
         except Exception:
             _lib = None
         return _lib
+
+
+def topk_abs(flat: np.ndarray, k: int,
+             n_threads: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Indices (ascending) and values of the ``k`` largest-|x| entries of a
+    1-D float32 array — thread-parallel nth_element when the native
+    library is present, numpy argpartition otherwise.  The top-k update
+    sparsifier's host-side hot op (fed/compression.py)."""
+    flat = np.ascontiguousarray(flat, dtype=np.float32)
+    k = int(k)
+    if not 0 < k <= flat.size:
+        raise ValueError(f"k={k} out of range for size {flat.size}")
+    lib = load()
+    if lib is not None and flat.size > 0:
+        idx = np.empty(k, np.int32)
+        val = np.empty(k, np.float32)
+        if n_threads <= 0:
+            n_threads = min(16, os.cpu_count() or 1)
+        rc = lib.cl_topk_abs(flat.ctypes.data, flat.size, k,
+                             idx.ctypes.data, val.ctypes.data, n_threads)
+        if rc == 0:
+            return idx, val
+    idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
+    idx = np.sort(idx).astype(np.int32)
+    return idx, flat[idx]
 
 
 def gather_rows(src: np.ndarray, indices: np.ndarray,

@@ -12,12 +12,12 @@ import shutil
 import subprocess
 
 _ROOT = pathlib.Path(__file__).resolve().parent
-SOURCES = [_ROOT / "src" / "gather.cpp"]
+SOURCES = [_ROOT / "src" / "gather.cpp", _ROOT / "src" / "topk.cpp"]
 # The ABI version is part of the FILENAME: a checkout upgrade can never
 # dlopen a stale cached binary under the new name, and a rebuild after a
 # runtime version mismatch loads from a fresh path (re-dlopening the same
 # path would return the stale handle already held by the process).
-ABI_VERSION = 1
+ABI_VERSION = 2  # v2: + cl_topk_abs
 LIB = _ROOT / "_build" / f"libcolearn_native_v{ABI_VERSION}.so"
 
 

@@ -57,6 +57,6 @@ int cl_gather_rows(const uint8_t* src, int64_t n_src_rows, int64_t row_bytes,
 }
 
 // Version marker so a stale cached .so is detected and rebuilt.
-int cl_abi_version() { return 1; }
+int cl_abi_version() { return 2; }  // v2: + cl_topk_abs (topk.cpp)
 
 }  // extern "C"

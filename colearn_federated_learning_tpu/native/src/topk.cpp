@@ -1,0 +1,154 @@
+// Thread-parallel top-k-by-magnitude selection for update sparsification
+// (fed/compression.py "topk" scheme).  The Python fallback is numpy
+// argpartition — single-threaded introselect over an |x| temporary.
+//
+// Algorithm: parallel radix-select on the float magnitude bits.  For
+// non-negative floats the IEEE-754 bit pattern is monotonic, so
+// (bits & 0x7FFFFFFF) orders |x| without computing fabs.  Two O(n) passes:
+//   1. per-thread 65536-bin histogram of the top magnitude bits; merge;
+//      walk from the top to find the boundary bin b* where the cumulative
+//      count crosses k.
+//   2. per-thread scan: indices in bins above b* are selected outright;
+//      boundary-bin candidates are collected and the exact remainder is
+//      chosen by nth_element over (mag_bits, idx) — only the boundary bin
+//      ever needs a selection pass, so the temporaries stay tiny.
+// No O(n) pair copies, both passes stream sequentially (HW prefetch),
+// and the only global sort is over the k selected indices.
+//
+// Exported C ABI (ctypes, native/__init__.py):
+//   cl_topk_abs(src, n, k, out_idx, out_val, n_threads) -> 0 on success
+// out_idx: k int32 indices in ASCENDING index order; out_val: src[idx].
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kBinBits = 16;
+constexpr int kBins = 1 << kBinBits;
+constexpr uint32_t kMagMask = 0x7FFFFFFFu;
+
+inline uint32_t mag_bits(float f) {
+  uint32_t b;
+  std::memcpy(&b, &f, sizeof(b));
+  return b & kMagMask;
+}
+
+inline uint32_t bin_of(uint32_t mb) { return mb >> (31 - kBinBits); }
+
+void hist_chunk(const float* src, int64_t lo, int64_t hi,
+                std::vector<int64_t>* hist) {
+  hist->assign(kBins, 0);
+  for (int64_t i = lo; i < hi; ++i) {
+    ++(*hist)[bin_of(mag_bits(src[i]))];
+  }
+}
+
+struct Boundary {
+  uint32_t mb;
+  int32_t idx;
+};
+
+void collect_chunk(const float* src, int64_t lo, int64_t hi, uint32_t bstar,
+                   std::vector<int32_t>* above, std::vector<Boundary>* bound) {
+  for (int64_t i = lo; i < hi; ++i) {
+    const uint32_t mb = mag_bits(src[i]);
+    const uint32_t b = bin_of(mb);
+    if (b > bstar) {
+      above->push_back(static_cast<int32_t>(i));
+    } else if (b == bstar) {
+      bound->push_back({mb, static_cast<int32_t>(i)});
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int cl_topk_abs(const float* src, int64_t n, int64_t k, int32_t* out_idx,
+                float* out_val, int32_t n_threads) {
+  if (n <= 0 || k <= 0 || k > n) return 1;
+  if (n_threads <= 0) {
+    n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 1;
+  }
+  const int64_t kMinPerThread = 1 << 16;
+  int64_t t = std::min<int64_t>(
+      n_threads, (n + kMinPerThread - 1) / kMinPerThread);
+  if (t < 1) t = 1;
+  const int64_t step = (n + t - 1) / t;
+
+  // Pass 1: magnitude-bit histograms.
+  std::vector<std::vector<int64_t>> hists(static_cast<size_t>(t));
+  {
+    std::vector<std::thread> threads;
+    for (int64_t i = 0; i < t; ++i) {
+      threads.emplace_back(hist_chunk, src, i * step,
+                           std::min(n, (i + 1) * step),
+                           &hists[static_cast<size_t>(i)]);
+    }
+    for (auto& th : threads) th.join();
+  }
+  int64_t cum = 0;
+  int bstar = 0;
+  for (int b = kBins - 1; b >= 0; --b) {
+    int64_t c = 0;
+    for (const auto& h : hists) c += h[static_cast<size_t>(b)];
+    if (cum + c >= k) {
+      bstar = b;
+      break;
+    }
+    cum += c;
+  }
+  const int64_t need = k - cum;  // entries to take from the boundary bin
+
+  // Pass 2: gather indices above the boundary + boundary candidates.
+  std::vector<std::vector<int32_t>> aboves(static_cast<size_t>(t));
+  std::vector<std::vector<Boundary>> bounds(static_cast<size_t>(t));
+  {
+    std::vector<std::thread> threads;
+    for (int64_t i = 0; i < t; ++i) {
+      threads.emplace_back(collect_chunk, src, i * step,
+                           std::min(n, (i + 1) * step),
+                           static_cast<uint32_t>(bstar),
+                           &aboves[static_cast<size_t>(i)],
+                           &bounds[static_cast<size_t>(i)]);
+    }
+    for (auto& th : threads) th.join();
+  }
+
+  std::vector<int32_t> sel;
+  sel.reserve(static_cast<size_t>(k));
+  for (const auto& a : aboves) sel.insert(sel.end(), a.begin(), a.end());
+  if (need > 0) {
+    std::vector<Boundary> bound;
+    size_t bn = 0;
+    for (const auto& b : bounds) bn += b.size();
+    bound.reserve(bn);
+    for (const auto& b : bounds) bound.insert(bound.end(), b.begin(), b.end());
+    // Exact remainder: largest magnitudes in the boundary bin, index
+    // tiebreak for determinism.
+    std::nth_element(bound.begin(), bound.begin() + need, bound.end(),
+                     [](const Boundary& a, const Boundary& b) {
+                       if (a.mb != b.mb) return a.mb > b.mb;
+                       return a.idx < b.idx;
+                     });
+    for (int64_t i = 0; i < need; ++i) {
+      sel.push_back(bound[static_cast<size_t>(i)].idx);
+    }
+  }
+  if (static_cast<int64_t>(sel.size()) != k) return 2;  // unreachable
+
+  std::sort(sel.begin(), sel.end());
+  for (int64_t i = 0; i < k; ++i) {
+    out_idx[i] = sel[static_cast<size_t>(i)];
+    out_val[i] = src[sel[static_cast<size_t>(i)]];
+  }
+  return 0;
+}
+
+}  // extern "C"

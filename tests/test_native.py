@@ -87,3 +87,36 @@ def test_pack_client_shards_native_matches_fallback(monkeypatch):
     np.testing.assert_array_equal(a.x, b.x)
     np.testing.assert_array_equal(a.y, b.y)
     np.testing.assert_array_equal(a.counts, b.counts)
+
+
+def test_topk_abs_matches_numpy_selection():
+    rng = np.random.default_rng(7)
+    for n, k in [(10, 3), (70_000, 3_500), (200_001, 1), (512, 512)]:
+        x = rng.normal(size=n).astype(np.float32)
+        idx, val = native.topk_abs(x, k)
+        assert idx.dtype == np.int32 and len(idx) == k
+        assert np.all(np.diff(idx) > 0)            # ascending, unique
+        np.testing.assert_array_equal(x[idx], val)
+        ref = np.argpartition(np.abs(x), n - k)[-k:]
+        # Selection must agree as a SET of magnitudes (tie order may vary).
+        np.testing.assert_allclose(np.sort(np.abs(val)),
+                                   np.sort(np.abs(x[ref])))
+
+
+def test_topk_abs_degenerate_distributions():
+    # Single-bin histograms (all-equal, all-zero) exercise the boundary
+    # nth_element path end-to-end.
+    idx, val = native.topk_abs(np.ones(100_000, np.float32), 777)
+    assert len(idx) == 777 and np.all(val == 1.0)
+    idx, val = native.topk_abs(np.zeros(100_000, np.float32), 777)
+    assert len(idx) == 777 and np.all(val == 0.0)
+
+
+def test_topk_abs_fallback_matches_native(monkeypatch):
+    x = np.random.default_rng(3).normal(size=50_001).astype(np.float32)
+    a_idx, a_val = native.topk_abs(x, 2_500)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    b_idx, b_val = native.topk_abs(x, 2_500)
+    np.testing.assert_allclose(np.sort(np.abs(a_val)),
+                               np.sort(np.abs(b_val)))

@@ -106,7 +106,9 @@ def test_median_survives_label_flip_poisoning():
 
 
 def test_trimmed_mean_learns_clean():
-    learner = FederatedLearner(_cfg("trimmed_mean"))
+    cfg = _cfg("trimmed_mean")
+    cfg = cfg.replace(fed=dataclasses.replace(cfg.fed, trim_fraction=0.2))
+    learner = FederatedLearner(cfg)
     learner.fit(rounds=8)
     _, acc = learner.evaluate()
     assert acc > 0.85, acc
@@ -132,6 +134,9 @@ def test_robust_mesh_matches_vmap(cpu_devices):
 
 
 def test_robust_guards():
+    # A trim that rounds to zero clients is a silent plain mean: loud error.
+    with pytest.raises(ValueError, match="trims zero"):
+        FederatedLearner(_cfg("trimmed_mean"))   # floor(0.1 * 8) == 0
     with pytest.raises(ValueError, match="secure-agg"):
         FederatedLearner(_cfg("median").replace(
             fed=dataclasses.replace(_cfg("median").fed, secure_agg=True)))
