@@ -49,6 +49,10 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    choices=["mean", "median", "trimmed_mean"],
                    help="Byzantine-robust server aggregation (fed/robust.py)")
     p.add_argument("--trim-fraction", type=float, default=None)
+    p.add_argument("--edge-groups", type=int, default=None,
+                   help=">= 2 turns on hierarchical edge->cloud federation "
+                        "(fed/hierarchical.py)")
+    p.add_argument("--edge-sync-period", type=int, default=None)
     p.add_argument("--dataset", default=None)
     p.add_argument("--partition", default=None, choices=["iid", "dirichlet"])
     p.add_argument("--dirichlet-alpha", type=float, default=None)
@@ -86,7 +90,8 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "prox_mu", "dp_clip", "dp_noise_multiplier", "dp_delta",
              "dp_adaptive_clip", "dp_target_quantile", "dp_clip_lr",
              "dp_bit_noise", "secure_agg", "secure_agg_neighbors",
-             "straggler_prob", "compress", "aggregator", "trim_fraction"}
+             "straggler_prob", "compress", "aggregator", "trim_fraction",
+             "edge_groups", "edge_sync_period"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}
@@ -138,6 +143,39 @@ def cmd_train(args: argparse.Namespace) -> int:
 
     from colearn_federated_learning_tpu.fed.engine import FederatedLearner
     from colearn_federated_learning_tpu.metrics import MetricsLogger
+
+    if config.fed.edge_groups >= 2:
+        from colearn_federated_learning_tpu.fed.hierarchical import (
+            HierarchicalLearner,
+        )
+
+        unsupported = [
+            flag for flag, on in [
+                ("--resume", args.resume),
+                ("--per-client-eval", args.per_client_eval),
+                ("--personalize-steps", bool(args.personalize_steps)),
+                ("--checkpoint-dir", bool(config.run.checkpoint_dir)),
+            ] if on
+        ]
+        if unsupported:
+            print(f"--edge-groups does not support {', '.join(unsupported)}",
+                  file=sys.stderr)
+            return 2
+        learner = HierarchicalLearner(
+            config, num_groups=config.fed.edge_groups,
+            sync_period=config.fed.edge_sync_period,
+        )
+        with MetricsLogger(path=args.log_file, name=config.run.name,
+                           tensorboard_dir=args.tensorboard_dir) as logger:
+            learner.fit(log_fn=lambda rec: (
+                logger.log(rec), print(json.dumps(rec), file=sys.stderr)
+            ))
+            loss, acc = learner.evaluate()
+            print(json.dumps({"name": config.run.name,
+                              "rounds": len(learner.history),
+                              "edge_groups": config.fed.edge_groups,
+                              "final_loss": loss, "final_acc": acc}))
+        return 0
 
     learner = FederatedLearner.from_config(config)
     with MetricsLogger(path=args.log_file, name=config.run.name,

@@ -480,6 +480,14 @@ class FederatedLearner:
             )
         deltas = results.delta
         completed = results.completed
+        # Round telemetry: per-client update norms (the quantity operators
+        # tune dp_clip against).  ONLY for non-private plain runs — under
+        # DP the exact un-noised norms are an unaccounted release (the
+        # adaptive path pays for even a 1-bit norm query), and under
+        # secure-agg they are precisely what the masks exist to hide.
+        track_norms = not (c.dp_clip > 0.0 or c.secure_agg)
+        if track_norms:
+            norms = jax.vmap(pytrees.tree_global_norm)(deltas)
 
         # SCAFFOLD averages uniformly over the sampled cohort (the variate
         # algebra assumes it); DP/secure-agg force uniform weights too.
@@ -566,6 +574,12 @@ class FederatedLearner:
             jnp.sum(bits * contrib.astype(jnp.float32))
             if bits is not None else jnp.zeros((), jnp.float32)
         )
+        if track_norms:
+            cf = contrib.astype(jnp.float32)
+            norm_sum = jnp.sum(norms * cf)
+            norm_max = jnp.max(norms * cf)
+        else:
+            norm_sum = norm_max = jnp.zeros((), jnp.float32)
 
         extras = None
         if self.scaffold:
@@ -581,11 +595,13 @@ class FederatedLearner:
                 sres.c_new, c_i,
             )
             extras = (dc_sum, n_completed.astype(jnp.float32), c_masked)
-        return wsum, total_w, (loss_sum, n_completed, bit_sum), extras
+        return (wsum, total_w,
+                (loss_sum, n_completed, bit_sum, norm_sum, norm_max), extras)
 
     def _finish_round(self, server_state, wsum, total_w, loss_sum, n_comp,
                       dc_sum=None, n_contrib=None, bit_sum=None, clip=None,
-                      key=None, round_idx=None):
+                      key=None, round_idx=None, norm_sum=None,
+                      norm_max=None):
         """Shared round epilogue (vmap and shard_map paths): mean delta,
         server update, metrics.  Zero contributors (all stragglers) → no-op
         update; the explicit gate matters under secure_agg, where wsum is
@@ -615,6 +631,12 @@ class FederatedLearner:
             "completed": n_comp,
             "total_weight": total_w,
         }
+        track_norms = not (self.config.fed.dp_clip > 0.0
+                           or self.config.fed.secure_agg)
+        if norm_sum is not None and track_norms:
+            safe_n = jnp.maximum(n_comp.astype(jnp.float32), 1.0)
+            metrics["delta_norm_mean"] = norm_sum / safe_n
+            metrics["delta_norm_max"] = norm_max
         if self.adaptive_clip:
             # Noised quantile fraction -> geometric clip step.  In the
             # shard_map path this runs replicated AFTER the psums: every
@@ -680,14 +702,13 @@ class FederatedLearner:
                     else:
                         sel = jnp.arange(self.num_clients)
                 cohort_global = jnp.take(ids, sel)
-                wsum, total_w, (loss_sum, n_comp, bit_sum), extras = (
-                    self._cohort_step(
-                        server_state.params, sel, cohort_global,
-                        cohort_global, x, y, counts, key, round_idx,
-                        control=server_state.control, c_blk=c_cohort,
-                        clip=clip_in,
-                    )
+                wsum, total_w, stats, extras = self._cohort_step(
+                    server_state.params, sel, cohort_global,
+                    cohort_global, x, y, counts, key, round_idx,
+                    control=server_state.control, c_blk=c_cohort,
+                    clip=clip_in,
                 )
+                loss_sum, n_comp, bit_sum, norm_sum, norm_max = stats
                 dc_sum, n_contrib, new_c = (
                     extras if extras is not None else (None, None, None)
                 )
@@ -695,6 +716,7 @@ class FederatedLearner:
                     server_state, wsum, total_w, loss_sum, n_comp,
                     dc_sum=dc_sum, n_contrib=n_contrib, bit_sum=bit_sum,
                     clip=clip_in, key=key, round_idx=round_idx,
+                    norm_sum=norm_sum, norm_max=norm_max,
                 )
                 return new_state, metrics, new_c
 
@@ -728,13 +750,12 @@ class FederatedLearner:
             # Secure-agg masks pair against the FULL mesh-wide cohort: a
             # cheap all_gather of the (cohort_per_device,) id vectors.
             mask_cohort = jax.lax.all_gather(cohort_global, ax).reshape(-1)
-            wsum, total_w, (loss_sum, n_comp, bit_sum), extras = (
-                self._cohort_step(
-                    server_state.params, sel, cohort_global, mask_cohort,
-                    x_blk, y_blk, counts_blk, key, round_idx,
-                    control=server_state.control, c_blk=c_blk, clip=clip_in,
-                )
+            wsum, total_w, stats, extras = self._cohort_step(
+                server_state.params, sel, cohort_global, mask_cohort,
+                x_blk, y_blk, counts_blk, key, round_idx,
+                control=server_state.control, c_blk=c_blk, clip=clip_in,
             )
+            loss_sum, n_comp, bit_sum, norm_sum, norm_max = stats
             # FedAvg across the pod: one psum over ICI per leaf.  (Robust
             # aggregates are already global+replicated — no psum.)
             if not self.robust:
@@ -743,6 +764,8 @@ class FederatedLearner:
             loss_sum = jax.lax.psum(loss_sum, ax)
             n_comp = jax.lax.psum(n_comp, ax)
             bit_sum = jax.lax.psum(bit_sum, ax)
+            norm_sum = jax.lax.psum(norm_sum, ax)
+            norm_max = jax.lax.pmax(norm_max, ax)
             if extras is not None:
                 dc_sum, n_contrib, new_c = extras
                 dc_sum = jax.tree.map(lambda l: jax.lax.psum(l, ax), dc_sum)
@@ -753,6 +776,7 @@ class FederatedLearner:
                 server_state, wsum, total_w, loss_sum, n_comp,
                 dc_sum=dc_sum, n_contrib=n_contrib, bit_sum=bit_sum,
                 clip=clip_in, key=key, round_idx=round_idx,
+                norm_sum=norm_sum, norm_max=norm_max,
             )
             return new_state, metrics, new_c
 

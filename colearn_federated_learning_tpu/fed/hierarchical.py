@@ -1,0 +1,178 @@
+"""Hierarchical (edge → cloud) federation — HierFAVG-style two-tier rounds.
+
+CoLearn's deployment picture is IoT devices behind edge gateways; the
+reference still aggregates FLAT (every device talks to the one
+coordinator, SURVEY.md §3a).  This module adds the two-tier topology
+(Liu et al. 1905.06641, client-edge-cloud pattern only): each EDGE GROUP
+runs full federated rounds over its own client population — reusing the
+jit round engine unchanged, one ``FederatedLearner`` per group — and every
+``sync_period`` rounds the edge models average into the cloud model
+(weighted by group example counts), which re-seeds every group.
+
+Communication shape this buys at the edge: devices talk only to their
+gateway every round; the WAN link carries one model per group every
+``sync_period`` rounds — a 1/sync_period cut of the reference's
+cloud-bound traffic.
+
+Scope: cloud sync averages PARAMS, so the strategies whose server state is
+exactly params (fedavg / fedprox) are supported; adaptive server
+optimizers keep per-group moments that a param average would silently
+desynchronise, and scaffold's variates live per-client — both are
+rejected loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from colearn_federated_learning_tpu.data import registry as data_registry
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
+from colearn_federated_learning_tpu.utils import pytrees
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+class HierarchicalLearner:
+    """Two-tier federated simulation (see module docstring).
+
+    ``num_groups`` edge groups each own a disjoint contiguous shard of the
+    training corpus and ``num_clients // num_groups`` clients, partitioned
+    within the group by the config's scheme (iid / dirichlet) — each edge
+    domain is its own population, which is exactly the non-IID structure
+    hierarchical FL exists for.
+    """
+
+    def __init__(self, config: ExperimentConfig, num_groups: int = 2,
+                 sync_period: int = 2):
+        if num_groups < 2:
+            raise ValueError(f"num_groups must be >= 2, got {num_groups}")
+        if sync_period < 1:
+            raise ValueError(f"sync_period must be >= 1, got {sync_period}")
+        if config.fed.strategy not in ("fedavg", "fedprox"):
+            raise ValueError(
+                "hierarchical sync averages params; strategy "
+                f"{config.fed.strategy!r} carries extra server state "
+                "(moments/variates) a param average would desynchronise"
+            )
+        self.config = config
+        self.num_groups = num_groups
+        self.sync_period = sync_period
+
+        if config.data.num_clients % num_groups:
+            raise ValueError(
+                f"num_clients={config.data.num_clients} is not divisible "
+                f"by num_groups={num_groups}; remainder clients would be "
+                "silently dropped while their data still lands in a group"
+            )
+        base = data_registry.get_dataset(config.data.dataset,
+                                         seed=config.run.seed)
+        n = len(base.y_train)
+        clients_per_group = config.data.num_clients // num_groups
+        self.groups: list[FederatedLearner] = []
+        self.group_examples: list[int] = []
+        for g in range(num_groups):
+            lo = g * n // num_groups
+            hi = (g + 1) * n // num_groups
+            ds = dataclasses.replace(
+                base,
+                x_train=base.x_train[lo:hi], y_train=base.y_train[lo:hi],
+            )
+            gcfg = config.replace(
+                data=dataclasses.replace(config.data,
+                                         num_clients=clients_per_group),
+                run=dataclasses.replace(
+                    config.run, name=f"{config.run.name}_edge{g}",
+                    # Distinct seeds de-correlate group cohort sampling /
+                    # client PRNG streams (client ids restart at 0 in
+                    # every group).
+                    seed=config.run.seed * num_groups + g,
+                ),
+            )
+            # from_config resolves --backend and lays any client mesh,
+            # exactly like the flat path.
+            self.groups.append(FederatedLearner.from_config(gcfg, dataset=ds))
+            self.group_examples.append(int(np.asarray(ds.y_train).size))
+
+        # Cloud model: start every group from the SAME init (group 0's).
+        self.global_params = self.groups[0].params
+        # Cloud aggregation as ONE jit program: eager per-leaf tree math
+        # would pay a remote dispatch per op on tunnel-attached TPUs.
+        import jax
+
+        w = np.asarray(self.group_examples, np.float64)
+        ws = tuple(float(x) for x in (w / w.sum()))
+
+        @jax.jit
+        def _sync(group_params):
+            acc = pytrees.tree_scale(group_params[0], ws[0])
+            for wi, p in zip(ws[1:], group_params[1:]):
+                acc = pytrees.tree_add(acc, pytrees.tree_scale(p, wi))
+            return acc
+
+        self._sync_fn = _sync
+        self._seed_groups()
+        self._eval_fn = make_eval_fn(
+            self.groups[0].eval_model.apply, base.x_test, base.y_test,
+            batch=max(config.fed.batch_size, 64),
+        )
+        self.history: list[dict] = []
+
+    # ------------------------------------------------------------------
+    def _seed_groups(self) -> None:
+        for g in self.groups:
+            g.server_state = g.server_state._replace(
+                params=self.global_params
+            )
+
+    def _cloud_sync(self) -> None:
+        """Cloud aggregation: example-count-weighted mean of edge models."""
+        self.global_params = self._sync_fn(
+            tuple(g.server_state.params for g in self.groups)
+        )
+        self._seed_groups()
+
+    def run_round(self) -> dict:
+        """One edge round in EVERY group; cloud sync on period boundaries."""
+        r = len(self.history)
+        recs = [g.run_round() for g in self.groups]
+        synced = (r + 1) % self.sync_period == 0
+        if synced:
+            self._cloud_sync()
+        out = {
+            "round": r,
+            "synced": synced,
+            "train_loss": float(np.mean([x["train_loss"] for x in recs])),
+            "completed": float(np.sum([x["completed"] for x in recs])),
+            "group_losses": [float(x["train_loss"]) for x in recs],
+        }
+        self.history.append(out)
+        return out
+
+    def evaluate(self) -> tuple[float, float]:
+        """Cloud-model score on the global holdout.  Between syncs the
+        cloud model is the LAST synced one; call after a sync boundary for
+        the freshest aggregate."""
+        loss, acc = self._eval_fn(self.global_params)
+        return float(loss), float(acc)
+
+    def fit(self, rounds: Optional[int] = None, log_fn=None) -> list[dict]:
+        rounds = rounds if rounds is not None else self.config.fed.rounds
+        run = self.config.run
+        last_round = len(self.history) + rounds - 1
+        for _ in range(rounds):
+            rec = self.run_round()
+            if rec["round"] == last_round and not rec["synced"]:
+                # Terminal sync (standard HierFAVG): the reported final
+                # model must fold the groups' last partial period, not a
+                # stale cloud aggregate.
+                self._cloud_sync()
+                rec["synced"] = True
+            if rec["synced"]:
+                loss, acc = self.evaluate()
+                rec["eval_loss"], rec["eval_acc"] = loss, acc
+            if log_fn is not None and rec["round"] % max(1, run.log_every) == 0:
+                log_fn(rec)
+        return self.history

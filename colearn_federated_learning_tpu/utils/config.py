@@ -57,6 +57,10 @@ class FedConfig:
     # mean with a coordinate-wise order statistic over the cohort.
     aggregator: str = "mean"          # mean | median | trimmed_mean
     trim_fraction: float = 0.1        # per-side trim for trimmed_mean
+    # Hierarchical (edge -> cloud) federation (fed/hierarchical.py):
+    # >= 2 edge groups run local rounds; cloud syncs every sync_period.
+    edge_groups: int = 0              # 0/1 = flat federation
+    edge_sync_period: int = 2
     server_beta1: float = 0.9         # FedAdam/FedYogi
     server_beta2: float = 0.99
     server_eps: float = 1e-3

@@ -107,3 +107,15 @@ def test_mesh_adaptive_matches_single_device(cpu_devices):
 def test_secure_agg_composition_rejected():
     with pytest.raises(ValueError, match="secure_agg"):
         FederatedLearner(_cfg(secure_agg=True))
+
+
+def test_round_metrics_include_update_norms_only_when_private_safe():
+    # Plain runs report pre-clip norm telemetry ...
+    learner = FederatedLearner(_cfg(dp_clip=0.0, dp_adaptive_clip=False))
+    rec = learner.run_round()
+    assert rec["delta_norm_max"] >= rec["delta_norm_mean"] > 0.0
+    # ... DP runs must NOT: exact un-noised norms are an unaccounted
+    # release the epsilon report would not cover.
+    dp = FederatedLearner(_cfg())
+    rec = dp.run_round()
+    assert "delta_norm_mean" not in rec and "delta_norm_max" not in rec
