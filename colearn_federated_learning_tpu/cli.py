@@ -155,6 +155,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                 ("--per-client-eval", args.per_client_eval),
                 ("--personalize-steps", bool(args.personalize_steps)),
                 ("--checkpoint-dir", bool(config.run.checkpoint_dir)),
+                ("--profile-dir", bool(config.run.profile_dir)),
             ] if on
         ]
         if unsupported:
@@ -291,6 +292,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             hist = coord.fit(
                 aggregations=remaining,
                 log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+                elastic=args.elastic,
             )
             print(json.dumps(hist[-1]))
         return 0

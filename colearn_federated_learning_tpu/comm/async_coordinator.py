@@ -176,13 +176,31 @@ class AsyncFederatedCoordinator:
             self._results.put((dev.device_id, header["meta"], delta, v))
 
     def _start_dispatchers(self) -> None:
-        if self._threads:
-            return
+        started = {t.name for t in self._threads}
         for d in self.trainers:
+            name = f"dispatch-{d.device_id}"
+            if name in started:
+                continue
             t = threading.Thread(target=self._dispatch_loop, args=(d,),
-                                 daemon=True, name=f"dispatch-{d.device_id}")
+                                 daemon=True, name=name)
             t.start()
             self._threads.append(t)
+
+    def refresh_membership(self, poll: float = 0.1) -> list[str]:
+        """Elastic late-join, async flavor: devices that enrolled after
+        ``enroll()`` get the trainer role and their own dispatch pump —
+        they start contributing to the NEXT aggregations immediately
+        (the sync coordinator's equivalent admits per round)."""
+        from colearn_federated_learning_tpu.comm.enrollment import (
+            admit_late_joiners,
+        )
+
+        admitted = admit_late_joiners(self._enroll, self._broker,
+                                      self.trainers, self.evaluator,
+                                      self._clients, poll)
+        if admitted and self._threads:
+            self._start_dispatchers()      # pumps for the newcomers only
+        return admitted
 
     # ------------------------------------------------------------------
     def run_aggregation(self) -> dict:
@@ -295,7 +313,8 @@ class AsyncFederatedCoordinator:
         return step
 
     def fit(self, aggregations: int, log_fn=None,
-            eval_every: Optional[int] = None) -> list[dict]:
+            eval_every: Optional[int] = None,
+            elastic: bool = False) -> list[dict]:
         eval_every = eval_every or self.config.run.eval_every
         run = self.config.run
         ckpt_every = max(0, run.checkpoint_every)
@@ -305,6 +324,8 @@ class AsyncFederatedCoordinator:
         # relative to where this call started.
         last = len(self.history) + aggregations - 1
         for _ in range(aggregations):
+            if elastic:
+                self.refresh_membership()
             rec = self.run_aggregation()
             if self.evaluator is not None and (
                 rec["aggregation"] % max(1, eval_every) == 0

@@ -101,25 +101,12 @@ class FederatedCoordinator:
         and join the next round's sampling pool.  The reference has no
         equivalent — workers present at startup are the federation forever;
         here the broker's retained enrollments make late joiners cheap."""
-        from colearn_federated_learning_tpu.comm.enrollment import ROLE_TOPIC
+        from colearn_federated_learning_tpu.comm.enrollment import (
+            admit_late_joiners,
+        )
 
-        self._enroll.poll(poll)
-        known = {d.device_id for d in self.trainers}
-        if self.evaluator is not None:
-            known.add(self.evaluator.device_id)
-        admitted = []
-        for d in self._enroll.devices():
-            if d.device_id in known:
-                continue
-            try:
-                self._clients[d.device_id] = TensorClient(d.host, d.port)
-            except OSError:
-                continue
-            self._broker.publish(ROLE_TOPIC + d.device_id,
-                                 {"role": "trainer"}, retain=True)
-            self.trainers.append(d)
-            admitted.append(d.device_id)
-        return admitted
+        return admit_late_joiners(self._enroll, self._broker, self.trainers,
+                                  self.evaluator, self._clients, poll)
 
     def _note_round_outcome(self, cohort, dropped) -> list[str]:
         """Track consecutive failures; evict peers dead for

@@ -135,3 +135,30 @@ class EnrollmentManager:
             self._client.publish(ROLE_TOPIC + evaluator.device_id,
                                  {"role": "evaluator"}, retain=True)
         return trainers, evaluator
+
+
+def admit_late_joiners(enroll: "EnrollmentManager", broker, trainers: list,
+                       evaluator, clients: dict, poll: float = 0.1) -> list:
+    """Elastic membership, shared by BOTH coordinators (sync round loop and
+    async pumps): poll enrollment, give every newcomer the trainer role
+    (retained), open its tensor connection into ``clients`` and append it
+    to ``trainers``.  Returns the admitted device ids."""
+    from colearn_federated_learning_tpu.comm.transport import TensorClient
+
+    enroll.poll(poll)
+    known = {d.device_id for d in trainers}
+    if evaluator is not None:
+        known.add(evaluator.device_id)
+    admitted = []
+    for d in enroll.devices():
+        if d.device_id in known:
+            continue
+        try:
+            clients[d.device_id] = TensorClient(d.host, d.port)
+        except OSError:
+            continue
+        broker.publish(ROLE_TOPIC + d.device_id,
+                       {"role": "trainer"}, retain=True)
+        trainers.append(d)
+        admitted.append(d.device_id)
+    return admitted

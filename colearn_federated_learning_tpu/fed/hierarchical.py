@@ -173,6 +173,9 @@ class HierarchicalLearner:
             if rec["synced"]:
                 loss, acc = self.evaluate()
                 rec["eval_loss"], rec["eval_acc"] = loss, acc
-            if log_fn is not None and rec["round"] % max(1, run.log_every) == 0:
+            if log_fn is not None and (
+                rec["round"] % max(1, run.log_every) == 0
+                or rec["round"] == last_round
+            ):
                 log_fn(rec)
         return self.history

@@ -21,6 +21,33 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite is dominated by XLA:CPU compiles
+# of the jit round programs, and most tests re-request programs an earlier
+# run (or another xdist worker) already built.  Keyed by host CPU features
+# like __graft_entry__'s cache — XLA:CPU AOT results can SIGILL on a
+# different host.
+try:
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as _f:
+            _feats = sorted(
+                {line for line in _f if line.startswith(("flags", "Features"))}
+            )
+    except OSError:
+        _feats = []
+    if not _feats:
+        import platform
+
+        _feats = [platform.machine(), platform.processor()]
+    _hostkey = hashlib.sha1("".join(_feats).encode()).hexdigest()[:10]
+    _cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_test_cache", _hostkey)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 import pytest  # noqa: E402
 
 

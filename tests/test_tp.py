@@ -181,6 +181,32 @@ def test_from_config_builds_tp_mesh(cpu_devices):
     assert learner.mesh.shape["clients"] == len(jax.devices()) // 2
 
 
+def test_tp_checkpoint_roundtrip(cpu_devices, tmp_path):
+    # Checkpoint/resume with TP-sharded server state: the restore targets
+    # the LIVE sharded arrays, so shardings must survive the roundtrip.
+    import dataclasses
+
+    cfg = _bert_cfg()
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, name="tp_ckpt", checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=1))
+    mesh = make_mesh(("clients", "model"), (4, 2), devices=cpu_devices[:8])
+    a = FederatedLearner(cfg, mesh=mesh)
+    a.fit(rounds=2)
+    p_before = np.concatenate([np.ravel(np.asarray(x))
+                               for x in jax.tree.leaves(a.server_state.params)])
+
+    b = FederatedLearner(cfg, mesh=mesh)
+    step = b.restore_checkpoint()
+    assert step == 2
+    q = b.server_state.params["TransformerBlock_0"][
+        "MultiHeadAttention_0"]["query"]["kernel"]
+    assert q.addressable_shards[0].data.shape[1] == q.shape[1] // 2
+    p_after = np.concatenate([np.ravel(np.asarray(x))
+                              for x in jax.tree.leaves(b.server_state.params)])
+    np.testing.assert_array_equal(p_before, p_after)
+
+
 def test_scaffold_rejects_tp(cpu_devices):
     cfg = _bert_cfg(strategy="scaffold", momentum=0.0)
     mesh = make_mesh(("clients", "model"), (4, 2), devices=cpu_devices[:8])
