@@ -46,7 +46,7 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    choices=["fedavg", "fedprox", "fedadam", "fedyogi", "scaffold"])
     p.add_argument("--prox-mu", type=float, default=None)
     p.add_argument("--aggregator", default=None,
-                   choices=["mean", "median", "trimmed_mean"],
+                   choices=["mean", "median", "trimmed_mean", "krum"],
                    help="Byzantine-robust server aggregation (fed/robust.py)")
     p.add_argument("--trim-fraction", type=float, default=None)
     p.add_argument("--edge-groups", type=int, default=None,

@@ -337,14 +337,16 @@ class FederatedLearner:
                     stacklevel=2,
                 )
             self.cohort_size = adjusted
-        if (self.robust and c.fed.aggregator == "trimmed_mean"
+        if (self.robust and c.fed.aggregator in ("trimmed_mean", "krum")
                 and int(c.fed.trim_fraction * self.cohort_size + 1e-4) < 1):
-            # floor(trim · cohort) == 0 trims nothing — the "robust"
-            # aggregate would silently be the plain mean while still
-            # paying uniform weights and the secure-agg/DP bans.
+            # floor(trim · cohort) == 0 trims/excludes nothing — the
+            # "robust" aggregate would silently be the plain mean while
+            # still paying uniform weights and the secure-agg/DP bans.
+            what = ("trims zero clients" if c.fed.aggregator == "trimmed_mean"
+                    else "assumes zero Byzantine clients (f = 0)")
             raise ValueError(
-                f"trim_fraction={c.fed.trim_fraction} trims zero clients "
-                f"at cohort_size={self.cohort_size}; raise it to at least "
+                f"trim_fraction={c.fed.trim_fraction} {what} at "
+                f"cohort_size={self.cohort_size}; raise it to at least "
                 f"{1.0 / self.cohort_size:.3f} (or use aggregator='median')"
             )
         # DP noise accounting divides by the number of REAL clients expected

@@ -22,8 +22,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-AGGREGATORS = ("mean", "median", "trimmed_mean")
+AGGREGATORS = ("mean", "median", "trimmed_mean", "krum")
 
 
 def _median_leaf(xs: jax.Array, n_valid: jax.Array) -> jax.Array:
@@ -49,6 +50,54 @@ def _trimmed_leaf(xs: jax.Array, n_valid: jax.Array,
     return jnp.sum(kept, axis=0) / count
 
 
+def _krum(stacked, maskb, n_valid, byz_fraction: float):
+    """Multi-Krum (Blanchard et al. 1703.02757, pattern only): score each
+    update by the sum of its ``n_valid − f − 2`` smallest squared
+    distances to other updates, select the ``n_valid − f`` best-scored,
+    average them.  ``f = floor(byz_fraction · n_valid)``.
+
+    Distance work is one gram matmul over the flattened cohort matrix —
+    (n, P)·(P, n) lands on the MXU; everything else is (n, n)-sized.
+    """
+    leaves = jax.tree.leaves(stacked)
+    X = jnp.concatenate(
+        [l.reshape(l.shape[0], -1).astype(jnp.float32) for l in leaves],
+        axis=1,
+    )                                                   # (n, P)
+    n = X.shape[0]
+    mf = maskb.astype(jnp.float32)
+    sq = jnp.sum(X * X, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (X @ X.T)    # (n, n)
+    inf = jnp.float32(3e38)
+    invalid = (1.0 - mf[:, None]) + (1.0 - mf[None, :])
+    d2 = jnp.where((invalid > 0) | jnp.eye(n, dtype=bool), inf, d2)
+    d2 = jnp.maximum(d2, 0.0)                           # gram round-off
+
+    f = jnp.floor(byz_fraction * n_valid + 1e-4).astype(jnp.int32)
+    k_nb = jnp.maximum(n_valid - f - 2, 1)              # neighbors scored
+    d2s = jnp.sort(d2, axis=1)                          # inf sorts last
+    nb_mask = (jnp.arange(n)[None, :] < k_nb).astype(jnp.float32)
+    # CLAMP huge distances rather than zeroing by value comparison: an
+    # attacker whose magnitudes overflow float32 would otherwise score 0
+    # (every neighbor distance "invalid") and be SELECTED — clamped, its
+    # astronomically bad score excludes it like any far outlier.
+    scores = jnp.sum(jnp.minimum(d2s, 1e30) * nb_mask, axis=1)
+    scores = jnp.where(maskb & ~jnp.isnan(scores), scores, jnp.inf)
+
+    m_sel = jnp.maximum(n_valid - f, 1)                 # multi-Krum size
+    order = jnp.argsort(scores)
+    rank = jnp.argsort(order)
+    sel = ((rank < m_sel) & maskb).astype(jnp.float32)
+    mean_flat = (sel @ X) / jnp.maximum(jnp.sum(sel), 1.0)
+
+    out, off = [], 0
+    for l in leaves:
+        size = int(np.prod(l.shape[1:])) if l.ndim > 1 else 1
+        out.append(mean_flat[off:off + size].reshape(l.shape[1:]))
+        off += size
+    return jax.tree.unflatten(jax.tree.structure(stacked), out)
+
+
 def robust_aggregate(stacked, mask, method: str,
                      trim_fraction: float = 0.1):
     """Aggregate client deltas robustly.
@@ -57,8 +106,9 @@ def robust_aggregate(stacked, mask, method: str,
       stacked: pytree whose leaves carry clients on axis 0.
       mask: (n,) bool/float — True for rows that actually contributed
         (real, non-straggler clients).
-      method: "median" | "trimmed_mean".
-      trim_fraction: per-side trim for "trimmed_mean".
+      method: "median" | "trimmed_mean" | "krum".
+      trim_fraction: per-side trim for "trimmed_mean"; the assumed
+        Byzantine FRACTION f/n for "krum".
 
     Returns the aggregated delta pytree (float32 leaves); all-zero when no
     row contributed (the engine's no-op-round convention).
@@ -74,6 +124,12 @@ def robust_aggregate(stacked, mask, method: str,
         )
     maskb = mask.astype(bool)
     n_valid = jnp.sum(maskb.astype(jnp.int32))
+
+    if method == "krum":
+        out = _krum(stacked, maskb, n_valid, trim_fraction)
+        return jax.tree.map(
+            lambda x: jnp.where(n_valid > 0, x, 0.0), out
+        )
 
     def leaf(x):
         m = maskb.reshape((-1,) + (1,) * (x.ndim - 1))

@@ -54,9 +54,12 @@ class FedConfig:
     prox_mu: float = 0.0              # FedProx μ (BASELINE config #3: 0.01)
     server_lr: float = 1.0            # server-side step on the mean delta
     # Byzantine-robust aggregation (fed/robust.py): replaces the weighted
-    # mean with a coordinate-wise order statistic over the cohort.
-    aggregator: str = "mean"          # mean | median | trimmed_mean
-    trim_fraction: float = 0.1        # per-side trim for trimmed_mean
+    # mean with an order statistic / distance-based selection over the
+    # cohort (see robust.AGGREGATORS for the canonical list).
+    aggregator: str = "mean"          # mean | median | trimmed_mean | krum
+    # Per-side trim for trimmed_mean; the assumed Byzantine FRACTION f/n
+    # for krum (both need floor(trim_fraction * cohort) >= 1).
+    trim_fraction: float = 0.1
     # Hierarchical (edge -> cloud) federation (fed/hierarchical.py):
     # >= 2 edge groups run local rounds; cloud syncs every sync_period.
     edge_groups: int = 0              # 0/1 = flat federation

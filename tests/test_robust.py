@@ -46,6 +46,36 @@ def test_robust_statistics_match_numpy():
     assert float(np.abs(np.asarray(zed["a"])).max()) == 0.0
 
 
+def test_krum_excludes_outliers():
+    rng = np.random.default_rng(1)
+    # 7 honest updates clustered at +1, 2 attackers far away.
+    x = (1.0 + 0.01 * rng.normal(size=(9, 16))).astype(np.float32)
+    x[0] = 50.0
+    x[4] = -50.0
+    tree = {"w": jnp.asarray(x)}
+    out = robust_aggregate(tree, jnp.ones(9, bool), "krum",
+                           trim_fraction=0.25)      # f = floor(.25*9) = 2
+    got = np.asarray(out["w"])
+    honest = np.delete(x, [0, 4], axis=0)
+    # Multi-Krum selects n-f = 7 best-scored: exactly the honest cluster.
+    np.testing.assert_allclose(got, honest.mean(axis=0), atol=1e-4)
+
+    # Masked rows never participate (attacker hidden behind the mask).
+    mask = np.ones(9, bool); mask[0] = False
+    out = robust_aggregate(tree, jnp.asarray(mask), "krum",
+                           trim_fraction=0.2)
+    assert np.abs(np.asarray(out["w"])).max() < 10.0
+
+    # Float32-overflow attacker: sum(x*x) = inf must yield a WORSE score,
+    # not a zero one (distance clamping, not zeroing).
+    x2 = (1.0 + 0.01 * rng.normal(size=(6, 16))).astype(np.float32)
+    x2[2] = 1e25                      # sq overflows float32
+    out = robust_aggregate({"w": jnp.asarray(x2)}, jnp.ones(6, bool),
+                           "krum", trim_fraction=0.2)
+    got = np.asarray(out["w"])
+    assert np.isfinite(got).all() and np.abs(got).max() < 10.0
+
+
 def _cfg(aggregator="mean", num_clients=8):
     return ExperimentConfig(
         data=DataConfig(dataset="mnist_tiny", num_clients=num_clients,
@@ -103,6 +133,16 @@ def test_median_survives_label_flip_poisoning():
     tm_l.fit(rounds=8)
     _, acc_tm = tm_l.evaluate()
     assert acc_tm > acc_mean + 0.1, (acc_tm, acc_mean)
+
+
+def test_krum_survives_label_flip_in_engine():
+    # Krum with f = floor(0.4*8) = 3 against 3 label-flippers.
+    cfg = _cfg("krum")
+    cfg = cfg.replace(fed=dataclasses.replace(cfg.fed, trim_fraction=0.4))
+    k_l = _LabelFlipLearner(cfg, n_bad=3)
+    k_l.fit(rounds=8)
+    _, acc = k_l.evaluate()
+    assert acc > 0.8, acc
 
 
 def test_trimmed_mean_learns_clean():
