@@ -344,10 +344,14 @@ class FederatedLearner:
             # still paying uniform weights and the secure-agg/DP bans.
             what = ("trims zero clients" if c.fed.aggregator == "trimmed_mean"
                     else "assumes zero Byzantine clients (f = 0)")
+            import math
+
+            # Round the suggestion UP so following it actually passes.
+            ok_frac = math.ceil(1e6 / self.cohort_size) / 1e6
             raise ValueError(
                 f"trim_fraction={c.fed.trim_fraction} {what} at "
                 f"cohort_size={self.cohort_size}; raise it to at least "
-                f"{1.0 / self.cohort_size:.3f} (or use aggregator='median')"
+                f"{ok_frac:.6f} (or use aggregator='median')"
             )
         # DP noise accounting divides by the number of REAL clients expected
         # to contribute (ghost padding never contributes).  If stragglers

@@ -64,6 +64,11 @@ def _krum(stacked, maskb, n_valid, byz_fraction: float):
         [l.reshape(l.shape[0], -1).astype(jnp.float32) for l in leaves],
         axis=1,
     )                                                   # (n, P)
+    # Masked/unselected rows multiply by 0 in the selection matmul below,
+    # and 0·NaN / 0·inf would poison every coordinate — sanitize the raw
+    # matrix (a diverged straggler's NaN delta is exactly the garbage the
+    # mask contract says we must survive).
+    X = jnp.where(jnp.isfinite(X), X, 0.0)
     n = X.shape[0]
     mf = maskb.astype(jnp.float32)
     sq = jnp.sum(X * X, axis=1)

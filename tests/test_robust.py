@@ -185,3 +185,17 @@ def test_robust_guards():
             fed=dataclasses.replace(_cfg("median").fed, dp_clip=1.0,
                                     dp_noise_multiplier=0.5)))
 
+
+
+def test_krum_survives_nan_rows():
+    # A masked row (dropped straggler) full of NaN must not poison the
+    # selection matmul (0 * NaN = NaN without sanitization).
+    rng = np.random.default_rng(5)
+    x = (1.0 + 0.01 * rng.normal(size=(6, 8))).astype(np.float32)
+    x[3] = np.nan
+    mask = np.ones(6, bool); mask[3] = False
+    out = robust_aggregate({"w": jnp.asarray(x)}, jnp.asarray(mask),
+                           "krum", trim_fraction=0.25)
+    got = np.asarray(out["w"])
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got.mean(), 1.0, atol=0.1)
