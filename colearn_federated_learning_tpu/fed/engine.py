@@ -344,6 +344,14 @@ class FederatedLearner:
             # still paying uniform weights and the secure-agg/DP bans.
             what = ("trims zero clients" if c.fed.aggregator == "trimmed_mean"
                     else "assumes zero Byzantine clients (f = 0)")
+            if self.cohort_size < 3:
+                # Any fraction satisfying floor(trim·cohort) >= 1 here
+                # would breach the < 0.5 cap: no valid value exists.
+                raise ValueError(
+                    f"aggregator={c.fed.aggregator!r} needs a cohort of at "
+                    f"least 3 (got {self.cohort_size}); use "
+                    "aggregator='median'"
+                )
             import math
 
             # Round the suggestion UP so following it actually passes.

@@ -64,10 +64,12 @@ def _krum(stacked, maskb, n_valid, byz_fraction: float):
         [l.reshape(l.shape[0], -1).astype(jnp.float32) for l in leaves],
         axis=1,
     )                                                   # (n, P)
-    # Masked/unselected rows multiply by 0 in the selection matmul below,
-    # and 0·NaN / 0·inf would poison every coordinate — sanitize the raw
-    # matrix (a diverged straggler's NaN delta is exactly the garbage the
-    # mask contract says we must survive).
+    # Rows with ANY nonfinite entry are excluded by construction (score
+    # forced to inf below): a valid-but-diverged or inf-submitting client
+    # must never be selected, and a masked straggler's NaN garbage must
+    # not leak.  The matrix itself is then sanitized so 0·NaN / 0·inf
+    # cannot poison the distance or selection matmuls.
+    row_bad = ~jnp.all(jnp.isfinite(X), axis=1)         # (n,)
     X = jnp.where(jnp.isfinite(X), X, 0.0)
     n = X.shape[0]
     mf = maskb.astype(jnp.float32)
@@ -87,12 +89,16 @@ def _krum(stacked, maskb, n_valid, byz_fraction: float):
     # (every neighbor distance "invalid") and be SELECTED — clamped, its
     # astronomically bad score excludes it like any far outlier.
     scores = jnp.sum(jnp.minimum(d2s, 1e30) * nb_mask, axis=1)
-    scores = jnp.where(maskb & ~jnp.isnan(scores), scores, jnp.inf)
+    scores = jnp.where(
+        maskb & ~row_bad & ~jnp.isnan(scores), scores, jnp.inf
+    )
 
     m_sel = jnp.maximum(n_valid - f, 1)                 # multi-Krum size
     order = jnp.argsort(scores)
     rank = jnp.argsort(order)
-    sel = ((rank < m_sel) & maskb).astype(jnp.float32)
+    # Never average in an excluded (inf-score) row, even when fewer than
+    # m_sel rows survive the exclusions.
+    sel = ((rank < m_sel) & maskb & jnp.isfinite(scores)).astype(jnp.float32)
     mean_flat = (sel @ X) / jnp.maximum(jnp.sum(sel), 1.0)
 
     out, off = [], 0

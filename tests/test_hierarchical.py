@@ -90,3 +90,18 @@ def test_rejects_stateful_strategies():
         HierarchicalLearner(_cfg(strategy="fedadam"), num_groups=2)
     with pytest.raises(ValueError, match="num_groups"):
         HierarchicalLearner(_cfg(), num_groups=1)
+
+
+def test_hierarchical_composes_with_robust_aggregation():
+    # Each edge group is a full engine: per-group Byzantine-robust
+    # aggregation composes with the cloud sync for free.
+    import dataclasses
+
+    cfg = _cfg()
+    cfg = cfg.replace(fed=dataclasses.replace(cfg.fed, aggregator="median"))
+    h = HierarchicalLearner(cfg, num_groups=2, sync_period=2)
+    assert all(g.robust for g in h.groups)
+    hist = h.fit(rounds=6)
+    assert np.isfinite(hist[-1]["train_loss"])
+    _, acc = h.evaluate()
+    assert acc > 0.85, acc

@@ -199,3 +199,18 @@ def test_krum_survives_nan_rows():
     got = np.asarray(out["w"])
     assert np.isfinite(got).all()
     np.testing.assert_allclose(got.mean(), 1.0, atol=0.1)
+
+
+def test_krum_excludes_valid_nonfinite_attacker():
+    # An UNMASKED attacker submitting inf/NaN must be excluded by score,
+    # not sanitized into an innocent-looking zero row that gets selected.
+    rng = np.random.default_rng(9)
+    x = (1.0 + 0.01 * rng.normal(size=(6, 8))).astype(np.float32)
+    x[1] = np.inf
+    out = robust_aggregate({"w": jnp.asarray(x)}, jnp.ones(6, bool),
+                           "krum", trim_fraction=0.2)
+    got = np.asarray(out["w"])
+    assert np.isfinite(got).all()
+    # Aggregate stays at the honest cluster (~1.0), NOT diluted toward 0
+    # by a zeroed attacker row.
+    np.testing.assert_allclose(got.mean(), 1.0, atol=0.05)
