@@ -83,6 +83,12 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of rounds 1-2 here")
+    p.add_argument("--attn-impl", default=None,
+                   choices=["dense", "flash", "ring"],
+                   help="attention core (models/attention.py)")
+    p.add_argument("--remat", action="store_true", default=None,
+                   help="rematerialize transformer blocks (jax.checkpoint): "
+                        "activation HBM ~depth -> ~1 block")
 
 
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
@@ -93,6 +99,7 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "straggler_prob", "compress", "aggregator", "trim_fraction",
              "edge_groups", "edge_sync_period"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
+_MODEL_KEYS = {"attn_impl", "remat"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}
 
@@ -109,7 +116,7 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
         except RuntimeError:
             pass                      # backend already initialized
     cfg = get_config(args.config)
-    sections = {"fed": {}, "data": {}, "run": {}}
+    sections = {"fed": {}, "data": {}, "model": {}, "run": {}}
     for key, val in vars(args).items():
         if val is None:
             continue
@@ -117,11 +124,14 @@ def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
             sections["fed"][key] = val
         elif key in _DATA_KEYS:
             sections["data"][key] = val
+        elif key in _MODEL_KEYS:
+            sections["model"][key] = val
         elif key in _RUN_KEYS:
             sections["run"][key] = val
     return cfg.replace(
         fed=dataclasses.replace(cfg.fed, **sections["fed"]),
         data=dataclasses.replace(cfg.data, **sections["data"]),
+        model=dataclasses.replace(cfg.model, **sections["model"]),
         run=dataclasses.replace(cfg.run, **sections["run"]),
     )
 

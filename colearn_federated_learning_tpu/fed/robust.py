@@ -72,7 +72,11 @@ def _krum(stacked, maskb, n_valid, byz_fraction: float):
     row_bad = ~jnp.all(jnp.isfinite(X), axis=1)         # (n,)
     X = jnp.where(jnp.isfinite(X), X, 0.0)
     n = X.shape[0]
-    mf = maskb.astype(jnp.float32)
+    # A nonfinite submitter is excluded EVERYWHERE: its zero-sanitized row
+    # must not act as anyone's nearest neighbor either (it would shrink
+    # small-norm clients' scores and shift the selection cutoff).
+    ok = maskb & ~row_bad
+    mf = ok.astype(jnp.float32)
     sq = jnp.sum(X * X, axis=1)
     d2 = sq[:, None] + sq[None, :] - 2.0 * (X @ X.T)    # (n, n)
     inf = jnp.float32(3e38)
@@ -89,9 +93,7 @@ def _krum(stacked, maskb, n_valid, byz_fraction: float):
     # (every neighbor distance "invalid") and be SELECTED — clamped, its
     # astronomically bad score excludes it like any far outlier.
     scores = jnp.sum(jnp.minimum(d2s, 1e30) * nb_mask, axis=1)
-    scores = jnp.where(
-        maskb & ~row_bad & ~jnp.isnan(scores), scores, jnp.inf
-    )
+    scores = jnp.where(ok & ~jnp.isnan(scores), scores, jnp.inf)
 
     m_sel = jnp.maximum(n_valid - f, 1)                 # multi-Krum size
     order = jnp.argsort(scores)

@@ -65,6 +65,9 @@ class BertClassifier(nn.Module):
     # into a mixture-of-experts block — the GShard interleaving, so deep
     # models keep dense MLPs between MoE layers.
     num_experts: int = 0
+    # Rematerialize each block under autodiff (activation HBM ∝ depth
+    # becomes ∝ 1 at the cost of one extra forward per block).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, ids, train: bool = False):
@@ -91,14 +94,22 @@ class BertClassifier(nn.Module):
             pos_l = pos[:, :L]
         x = tok + pos_l.astype(self.dtype)
         x = nn.LayerNorm(dtype=self.dtype)(x)
+        block_cls = (
+            nn.remat(TransformerBlock) if self.remat else TransformerBlock
+        )
         for i in range(self.depth):
             moe_here = self.num_experts > 0 and (
                 i % 2 == 1 or self.depth == 1
             )
-            x = TransformerBlock(self.embed_dim, self.num_heads, dtype=self.dtype,
-                                 attn_impl=self.attn_impl,
-                                 attn_axis_name=sp,
-                                 num_experts=self.num_experts if moe_here else 0)(
+            # Explicit names pin the param paths: nn.remat's auto-prefix
+            # ("CheckpointTransformerBlock_i") would otherwise fork the
+            # pytree from the non-remat twin, breaking checkpoints, wire
+            # payloads and the TP partition rules.
+            x = block_cls(self.embed_dim, self.num_heads, dtype=self.dtype,
+                          attn_impl=self.attn_impl,
+                          attn_axis_name=sp,
+                          num_experts=self.num_experts if moe_here else 0,
+                          name=f"TransformerBlock_{i}")(
                 x, pad_mask
             )
         # Masked mean pooling (no [CLS] convention in the synthetic corpus);

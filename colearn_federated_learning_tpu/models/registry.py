@@ -25,6 +25,12 @@ def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
             "sequence parallelism is only supported for 'bert'/'moe_bert', "
             f"not {cfg.name!r}"
         )
+    if cfg.remat and cfg.name not in ("bert", "moe_bert", "vit_b16"):
+        raise ValueError(
+            "remat is only implemented for the transformer families "
+            f"(bert/moe_bert/vit_b16), not {cfg.name!r} — silently "
+            "ignoring it would fake the memory savings"
+        )
     if cfg.name == "mlp":
         from colearn_federated_learning_tpu.models.mlp import MLP
 
@@ -45,7 +51,7 @@ def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
                               embed_dim=cfg.width, depth=cfg.depth,
                               num_heads=cfg.num_heads, max_len=cfg.seq_len,
                               dtype=dtype, attn_impl=cfg.attn_impl,
-                              seq_axis_name=seq_axis_name)
+                              seq_axis_name=seq_axis_name, remat=cfg.remat)
     if cfg.name == "moe_bert":
         from colearn_federated_learning_tpu.models.bert import BertClassifier
 
@@ -57,14 +63,14 @@ def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
                               max_len=cfg.seq_len, dtype=dtype,
                               attn_impl=cfg.attn_impl,
                               seq_axis_name=seq_axis_name,
-                              num_experts=cfg.num_experts)
+                              num_experts=cfg.num_experts, remat=cfg.remat)
     if cfg.name == "vit_b16":
         from colearn_federated_learning_tpu.models.vit import ViT
 
         return ViT(num_classes=cfg.num_classes, embed_dim=cfg.width,
                    depth=cfg.depth, num_heads=cfg.num_heads,
                    patch_size=cfg.patch_size, dtype=dtype,
-                   attn_impl=cfg.attn_impl)
+                   attn_impl=cfg.attn_impl, remat=cfg.remat)
     raise KeyError(f"unknown model {cfg.name!r}")
 
 

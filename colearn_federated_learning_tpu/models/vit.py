@@ -43,6 +43,8 @@ class ViT(nn.Module):
     patch_size: int = 16
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "dense"
+    # Rematerialize blocks under autodiff (models/bert.py ditto).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -61,9 +63,12 @@ class ViT(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (1, x.shape[1], self.embed_dim)
         )
         x = x + pos.astype(self.dtype)
-        for _ in range(self.depth):
-            x = ViTBlock(self.embed_dim, self.num_heads, dtype=self.dtype,
-                         attn_impl=self.attn_impl)(x)
+        block_cls = nn.remat(ViTBlock) if self.remat else ViTBlock
+        for i in range(self.depth):
+            # Explicit names pin param paths across remat (models/bert.py).
+            x = block_cls(self.embed_dim, self.num_heads, dtype=self.dtype,
+                          attn_impl=self.attn_impl,
+                          name=f"ViTBlock_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32)(x[:, 0])
         return logits

@@ -38,6 +38,10 @@ class ModelConfig:
     attn_impl: str = "dense"          # "dense" | "flash" (pallas) | "ring" (SP)
     num_experts: int = 4              # MoE families (models/moe.py)
     moe_aux_weight: float = 0.01      # Switch load-balance loss weight
+    # Rematerialize transformer blocks under autodiff (jax.checkpoint):
+    # trades recompute FLOPs for activation HBM — how deep models fit
+    # long local training on a chip.
+    remat: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
