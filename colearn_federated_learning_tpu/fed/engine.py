@@ -375,12 +375,6 @@ class FederatedLearner:
                 raise ValueError(
                     "dp_adaptive_clip needs dp_clip > 0 as the initial norm"
                 )
-            if c.fed.secure_agg:
-                raise ValueError(
-                    "dp_adaptive_clip with secure_agg is unsupported: the "
-                    "quantile bits are a second scalar payload the pairwise "
-                    "masks do not cover"
-                )
             z = c.fed.dp_noise_multiplier
             if z > 0.0:
                 self.dp_bit_noise = c.fed.dp_bit_noise or max(
@@ -534,6 +528,7 @@ class FederatedLearner:
         else:
             weights = results.num_examples.astype(jnp.float32) * contrib
 
+        sa_bit_sum = None
         if c.secure_agg:
             # Clients pre-scale by their weight, then add pairwise masks;
             # masks cancel in the plain SUM over the cohort.  Masks pair
@@ -552,6 +547,20 @@ class FederatedLearner:
                                                      round_idx)
             )(wdeltas, global_ids, partners)
             wsum = jax.tree.map(lambda l: jnp.sum(l, axis=0), masked)
+            if bits is not None:
+                # Adaptive clipping under secure-agg: the quantile bit is a
+                # second payload — mask it on its own pair stream so only
+                # the cohort SUM is visible, like the deltas (the
+                # contribution weighting is folded in pre-mask).
+                # std ≫ 1: a unit-scale mask on a {0,1} payload would leak
+                # the bit with constant statistical advantage; at 1e3 the
+                # float32 cancellation residual (~1e-7·std·√cohort) is
+                # still far below the O(cohort) bit sum.
+                masked_bits = jax.vmap(
+                    lambda b, i, prt: sa_lib.mask_scalar(b, key, i, prt,
+                                                         round_idx, std=1e3)
+                )(bits * contrib.astype(jnp.float32), global_ids, partners)
+                sa_bit_sum = jnp.sum(masked_bits)
         elif self.robust:
             # Coordinate-wise robust statistic over the FULL cohort
             # (fed/robust.py).  Order statistics are not psum-decomposable,
@@ -583,11 +592,15 @@ class FederatedLearner:
         # always finish their budget but never contribute).
         n_completed = jnp.sum(contrib.astype(jnp.int32))
         # Quantile-bit sum over CONTRIBUTORS (the clip adapts to the norms
-        # that actually entered the aggregate).
-        bit_sum = (
-            jnp.sum(bits * contrib.astype(jnp.float32))
-            if bits is not None else jnp.zeros((), jnp.float32)
-        )
+        # that actually entered the aggregate).  Under secure-agg the
+        # masked sum computed above stands in (cancellation ⇒ same value
+        # up to float32 residual).
+        if sa_bit_sum is not None:
+            bit_sum = sa_bit_sum
+        elif bits is not None:
+            bit_sum = jnp.sum(bits * contrib.astype(jnp.float32))
+        else:
+            bit_sum = jnp.zeros((), jnp.float32)
         if track_norms:
             cf = contrib.astype(jnp.float32)
             norm_sum = jnp.sum(norms * cf)

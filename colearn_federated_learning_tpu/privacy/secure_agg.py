@@ -115,6 +115,31 @@ def mask_update(update, base_key: jax.Array, client_id, partner_ids, round_idx,
     return pytrees.tree_add(update, mask)
 
 
+_SCALAR_STREAM_TAG = 0x7B17
+
+
+def mask_scalar(value, base_key: jax.Array, client_id, partner_ids,
+                round_idx, std: float = 1.0):
+    """Pairwise-mask one SCALAR side-channel value (e.g. the adaptive-
+    clipping quantile bit) with the same cancellation algebra as the
+    update masks — but on a stream derived with a DISTINCT tag, so an
+    observer can never difference a masked update leaf against the masked
+    scalar to cancel the shared mask."""
+
+    def body(j, acc):
+        other = partner_ids[j]
+        k = jax.random.fold_in(
+            prng.pair_mask_key(base_key, client_id, other, round_idx),
+            _SCALAR_STREAM_TAG,
+        )
+        sign = jnp.sign(other - client_id).astype(jnp.float32)
+        return acc + sign * std * jax.random.normal(k, (), jnp.float32)
+
+    return value + jax.lax.fori_loop(
+        0, partner_ids.shape[0], body, jnp.zeros((), jnp.float32)
+    )
+
+
 def partner_table(base_key: jax.Array, member_ids, cohort_ids, round_idx,
                   neighbors: int = 0):
     """(M, P) partner ids per member: the random ring when ``neighbors`` is

@@ -104,9 +104,31 @@ def test_mesh_adaptive_matches_single_device(cpu_devices):
                                rtol=1e-4)
 
 
-def test_secure_agg_composition_rejected():
-    with pytest.raises(ValueError, match="secure_agg"):
-        FederatedLearner(_cfg(secure_agg=True))
+def test_secure_agg_composition_masks_bits_and_matches():
+    # Adaptive clipping composes with secure aggregation: the quantile
+    # bits ride their own pairwise-mask stream and cancel in the sum, so
+    # the clip trajectory matches the unmasked run up to the float32
+    # mask-cancellation residual.
+    plain = FederatedLearner(_cfg())
+    masked = FederatedLearner(_cfg(secure_agg=True))
+    for _ in range(3):
+        r_p = plain.run_round()
+        r_m = masked.run_round()
+    np.testing.assert_allclose(r_m["dp_bit_frac"], r_p["dp_bit_frac"],
+                               atol=5e-3)
+    np.testing.assert_allclose(r_m["dp_clip"], r_p["dp_clip"], rtol=1e-3)
+    np.testing.assert_allclose(r_m["train_loss"], r_p["train_loss"],
+                               rtol=1e-3)
+
+    # ... and each INDIVIDUAL masked bit is actually hidden: the per-lane
+    # payload sits nowhere near {0, 1} (trajectory equality alone would
+    # also hold if masking silently regressed to a no-op).
+    from colearn_federated_learning_tpu.privacy import secure_agg as sa
+
+    partners = jnp.asarray([1, 2], jnp.int32)
+    m = sa.mask_scalar(jnp.float32(1.0), masked.base_key, jnp.int32(0),
+                       partners, jnp.int32(0), std=1e3)
+    assert min(abs(float(m)), abs(float(m) - 1.0)) > 1.0
 
 
 def test_round_metrics_include_update_norms_only_when_private_safe():
