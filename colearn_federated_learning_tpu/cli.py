@@ -43,7 +43,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--local-optimizer", default=None,
                    choices=["sgd", "adam", "adamw"])
     p.add_argument("--strategy", default=None,
-                   choices=["fedavg", "fedprox", "fedadam", "fedyogi", "scaffold"])
+                   choices=["fedavg", "fedprox", "fedadam", "fedyogi",
+                            "scaffold", "fednova"])
     p.add_argument("--prox-mu", type=float, default=None)
     p.add_argument("--aggregator", default=None,
                    choices=["mean", "median", "trimmed_mean", "krum"],

@@ -252,6 +252,7 @@ class FederatedLearner:
 
         # --- local trainer -------------------------------------------
         self.scaffold = c.fed.strategy == "scaffold"
+        self.fednova = c.fed.strategy == "fednova"
         if c.fed.secure_agg and c.fed.secure_agg_neighbors and (
             c.fed.secure_agg_neighbors % 2 or c.fed.secure_agg_neighbors < 2
         ):
@@ -488,6 +489,21 @@ class FederatedLearner:
             )
         deltas = results.delta
         completed = results.completed
+        nova_a = None
+        if self.fednova:
+            # FedNova (Wang et al., pattern only): normalize each delta by
+            # its effective local-step coefficient a_i, so heterogeneous
+            # step counts (straggler budgets!) stop biasing the objective;
+            # the round epilogue rescales the mean by the weighted mean a.
+            m = c.momentum
+            tau = jnp.maximum(results.steps_run, 1.0)
+            if m > 0.0:
+                nova_a = (tau - m * (1.0 - m ** tau) / (1.0 - m)) / (1.0 - m)
+            else:
+                nova_a = tau
+            deltas = jax.vmap(
+                lambda d, a: pytrees.tree_scale(d, 1.0 / a)
+            )(deltas, nova_a)
         # Round telemetry: per-client update norms (the quantity operators
         # tune dp_clip against).  ONLY for non-private plain runs — under
         # DP the exact un-noised norms are an unaccounted release (the
@@ -607,6 +623,12 @@ class FederatedLearner:
             norm_max = jnp.max(norms * cf)
         else:
             norm_sum = norm_max = jnp.zeros((), jnp.float32)
+        # FedNova: weighted sum of the a_i coefficients — the epilogue's
+        # mean rescale factor is nova_sum / total_w.
+        nova_sum = (
+            jnp.sum(weights * nova_a)
+            if nova_a is not None else jnp.zeros((), jnp.float32)
+        )
 
         extras = None
         if self.scaffold:
@@ -623,12 +645,13 @@ class FederatedLearner:
             )
             extras = (dc_sum, n_completed.astype(jnp.float32), c_masked)
         return (wsum, total_w,
-                (loss_sum, n_completed, bit_sum, norm_sum, norm_max), extras)
+                (loss_sum, n_completed, bit_sum, norm_sum, norm_max,
+                 nova_sum), extras)
 
     def _finish_round(self, server_state, wsum, total_w, loss_sum, n_comp,
                       dc_sum=None, n_contrib=None, bit_sum=None, clip=None,
                       key=None, round_idx=None, norm_sum=None,
-                      norm_max=None):
+                      norm_max=None, nova_sum=None):
         """Shared round epilogue (vmap and shard_map paths): mean delta,
         server update, metrics.  Zero contributors (all stragglers) → no-op
         update; the explicit gate matters under secure_agg, where wsum is
@@ -642,6 +665,10 @@ class FederatedLearner:
             mean_delta = pytrees.tree_scale(
                 wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0)
             )
+        if self.fednova and nova_sum is not None:
+            # Rescale the mean of NORMALIZED deltas by the weighted-mean
+            # step coefficient (tau_eff), completing d = tau_eff * mean.
+            mean_delta = pytrees.tree_scale(mean_delta, nova_sum / denom)
         mean_delta_c = participation = None
         if self.scaffold:
             safe_n = jnp.maximum(n_contrib, 1.0)
@@ -735,7 +762,8 @@ class FederatedLearner:
                     control=server_state.control, c_blk=c_cohort,
                     clip=clip_in,
                 )
-                loss_sum, n_comp, bit_sum, norm_sum, norm_max = stats
+                (loss_sum, n_comp, bit_sum, norm_sum, norm_max,
+                 nova_sum) = stats
                 dc_sum, n_contrib, new_c = (
                     extras if extras is not None else (None, None, None)
                 )
@@ -744,6 +772,7 @@ class FederatedLearner:
                     dc_sum=dc_sum, n_contrib=n_contrib, bit_sum=bit_sum,
                     clip=clip_in, key=key, round_idx=round_idx,
                     norm_sum=norm_sum, norm_max=norm_max,
+                    nova_sum=nova_sum,
                 )
                 return new_state, metrics, new_c
 
@@ -782,7 +811,8 @@ class FederatedLearner:
                 x_blk, y_blk, counts_blk, key, round_idx,
                 control=server_state.control, c_blk=c_blk, clip=clip_in,
             )
-            loss_sum, n_comp, bit_sum, norm_sum, norm_max = stats
+            (loss_sum, n_comp, bit_sum, norm_sum, norm_max,
+             nova_sum) = stats
             # FedAvg across the pod: one psum over ICI per leaf.  (Robust
             # aggregates are already global+replicated — no psum.)
             if not self.robust:
@@ -793,6 +823,7 @@ class FederatedLearner:
             bit_sum = jax.lax.psum(bit_sum, ax)
             norm_sum = jax.lax.psum(norm_sum, ax)
             norm_max = jax.lax.pmax(norm_max, ax)
+            nova_sum = jax.lax.psum(nova_sum, ax)
             if extras is not None:
                 dc_sum, n_contrib, new_c = extras
                 dc_sum = jax.tree.map(lambda l: jax.lax.psum(l, ax), dc_sum)
@@ -804,6 +835,7 @@ class FederatedLearner:
                 dc_sum=dc_sum, n_contrib=n_contrib, bit_sum=bit_sum,
                 clip=clip_in, key=key, round_idx=round_idx,
                 norm_sum=norm_sum, norm_max=norm_max,
+                nova_sum=nova_sum,
             )
             return new_state, metrics, new_c
 

@@ -33,6 +33,8 @@ class LocalResult(NamedTuple):
     num_examples: jnp.ndarray  # () int32 — true shard size (FedAvg weight)
     completed: jnp.ndarray     # () bool — ran >= min required steps
     mean_loss: jnp.ndarray     # () float32 over executed steps
+    steps_run: jnp.ndarray     # () float32 — executed step count (FedNova
+                               # normalizes by it; varies under stragglers)
 
 
 class ScaffoldResult(NamedTuple):
@@ -174,6 +176,7 @@ def make_local_update(
             num_examples=count.astype(jnp.int32),
             completed=step_budget >= min_steps,
             mean_loss=mean_loss,
+            steps_run=executed,
         )
         return result, executed
 

@@ -70,6 +70,12 @@ def local_trainer_for_config(
             "scaffold's option-II variate refresh assumes plain SGD steps; "
             f"local_optimizer={c.local_optimizer!r} is unsupported"
         )
+    if c.strategy == "fednova" and c.local_optimizer != "sgd":
+        raise ValueError(
+            "fednova's step coefficient a_i models SGD(+momentum) "
+            f"dynamics; local_optimizer={c.local_optimizer!r} does not "
+            "follow that geometric series and would be mis-normalized"
+        )
     if c.strategy == "scaffold" and c.momentum != 0.0:
         # Option-II refresh c_i' = c_i - c + (w_g - w_l)/(K*lr) equals the
         # mean corrected gradient ONLY under vanilla SGD; momentum silently
@@ -98,12 +104,20 @@ def local_trainer_for_config(
 
 def require_stateless_strategy(config: ExperimentConfig, where: str) -> None:
     """File/socket participants keep no cross-round client state, so the
-    stateful SCAFFOLD strategy only runs in the on-device engine."""
+    stateful SCAFFOLD strategy only runs in the on-device engine; FedNova
+    is engine-only too — the wire/file folding is a plain weighted mean,
+    which is exactly the step-count inconsistency FedNova corrects."""
     if config.fed.strategy == "scaffold":
         raise NotImplementedError(
             f"{where} does not support 'scaffold' (per-client control "
             "variates are engine-resident); use the on-device simulation "
             "or a stateless strategy"
+        )
+    if config.fed.strategy == "fednova":
+        raise NotImplementedError(
+            f"{where} does not support 'fednova' (its normalized "
+            "aggregation is engine-resident); use the on-device "
+            "simulation or fedavg/fedprox"
         )
 
 

@@ -8,6 +8,11 @@ psum across the mesh) and applies a server optimizer:
 - fedavg / fedprox : w ← w + server_lr · Δ̄   (server_lr=1 reproduces the
   classic weighted-parameter-mean exactly; FedProx differs only in the
   client loss, fed/local.py)
+- fednova          : same server step, but the engine normalizes each
+  client delta by its effective local-step coefficient and rescales the
+  mean (Wang et al., "Tackling the Objective Inconsistency Problem" —
+  pattern only; fed/engine.py) so heterogeneous step counts, e.g. under
+  straggler budgets, stop biasing the objective
 - fedadam / fedyogi: adaptive server optimizers (Reddi et al., "Adaptive
   Federated Optimization" — capability superset of the reference)
 - scaffold        : control-variate correction (Karimireddy et al.) — the
@@ -61,7 +66,7 @@ def server_update(
     ``mean_delta_c`` / ``participation`` (|S|/N) are scaffold-only: the
     global variate moves by ``participation · mean_delta_c``.
     """
-    if cfg.strategy in ("fedavg", "fedprox", "scaffold"):
+    if cfg.strategy in ("fedavg", "fedprox", "scaffold", "fednova"):
         new_params = jax.tree.map(
             lambda w, d: w + cfg.server_lr * d.astype(w.dtype),
             state.params, mean_delta,

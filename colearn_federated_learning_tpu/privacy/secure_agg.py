@@ -121,22 +121,15 @@ _SCALAR_STREAM_TAG = 0x7B17
 def mask_scalar(value, base_key: jax.Array, client_id, partner_ids,
                 round_idx, std: float = 1.0):
     """Pairwise-mask one SCALAR side-channel value (e.g. the adaptive-
-    clipping quantile bit) with the same cancellation algebra as the
-    update masks — but on a stream derived with a DISTINCT tag, so an
-    observer can never difference a masked update leaf against the masked
-    scalar to cancel the shared mask."""
-
-    def body(j, acc):
-        other = partner_ids[j]
-        k = jax.random.fold_in(
-            prng.pair_mask_key(base_key, client_id, other, round_idx),
-            _SCALAR_STREAM_TAG,
-        )
-        sign = jnp.sign(other - client_id).astype(jnp.float32)
-        return acc + sign * std * jax.random.normal(k, (), jnp.float32)
-
-    return value + jax.lax.fori_loop(
-        0, partner_ids.shape[0], body, jnp.zeros((), jnp.float32)
+    clipping quantile bit).  Same cancellation algebra as the update
+    masks — literally :func:`pairwise_mask` on a scalar template — but on
+    a base key folded with a DISTINCT tag, so an observer can never
+    difference a masked update leaf against the masked scalar to cancel a
+    shared mask."""
+    tagged = jax.random.fold_in(base_key, _SCALAR_STREAM_TAG)
+    return value + pairwise_mask(
+        jnp.zeros((), jnp.float32), tagged, client_id, partner_ids,
+        round_idx, std,
     )
 
 

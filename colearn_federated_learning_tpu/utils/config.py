@@ -46,7 +46,7 @@ class ModelConfig:
 
 @dataclasses.dataclass(frozen=True)
 class FedConfig:
-    strategy: str = "fedavg"          # fedavg | fedprox | fedadam | fedyogi | scaffold
+    strategy: str = "fedavg"          # fedavg | fedprox | fedadam | fedyogi | scaffold | fednova
     rounds: int = 20
     cohort_size: int = 0              # clients sampled per round; 0 = all
     local_epochs: int = 1

@@ -1,0 +1,110 @@
+"""FedNova normalized averaging (fed/engine.py, strategy="fednova").
+
+Wang et al.'s objective-inconsistency fix, engine-resident: each client
+delta is normalized by its effective local-step coefficient a_i and the
+mean is rescaled by the weighted-mean coefficient.  The fit with this
+framework: straggler step budgets make tau_i genuinely heterogeneous.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def _cfg(**fed_kw):
+    fed = dict(strategy="fednova", rounds=5, cohort_size=0, local_steps=4,
+               batch_size=16, lr=0.1, momentum=0.9)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=8, partition="iid",
+                        max_examples_per_client=64),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="fednova_test"),
+    )
+
+
+def _flat(tree):
+    import jax
+
+    return np.concatenate([np.ravel(np.asarray(a))
+                           for a in jax.tree.leaves(tree)])
+
+
+def test_fednova_equals_fedavg_when_steps_homogeneous():
+    # Equal tau and equal example counts: a_i identical for every client,
+    # so the normalization and the rescale cancel exactly.
+    nova = FederatedLearner(_cfg())
+    avg = FederatedLearner(_cfg(strategy="fedavg"))
+    for _ in range(2):
+        r_n = nova.run_round()
+        r_a = avg.run_round()
+    np.testing.assert_allclose(r_n["train_loss"], r_a["train_loss"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(_flat(nova.server_state.params),
+                               _flat(avg.server_state.params), atol=1e-5)
+
+
+def test_fednova_differs_and_learns_under_stragglers():
+    # Heterogeneous tau (straggler budgets): fednova reweights and must
+    # diverge from fedavg while still learning.
+    nova = FederatedLearner(_cfg(straggler_prob=0.5,
+                                 straggler_min_fraction=0.01))
+    avg = FederatedLearner(_cfg(strategy="fedavg", straggler_prob=0.5,
+                                straggler_min_fraction=0.01))
+    nova.fit(rounds=8)
+    avg.fit(rounds=8)
+    d = np.abs(_flat(nova.server_state.params)
+               - _flat(avg.server_state.params)).max()
+    assert d > 1e-4, d
+    _, acc = nova.evaluate()
+    assert acc > 0.8, acc
+
+
+def test_fednova_mesh_matches_vmap(cpu_devices):
+    cfg = _cfg(straggler_prob=0.3, straggler_min_fraction=0.01)
+    ref = FederatedLearner(cfg)
+    m = FederatedLearner(cfg, mesh=Mesh(np.array(cpu_devices[:8]),
+                                        ("clients",)))
+    for _ in range(2):
+        r_ref = ref.run_round()
+        r_m = m.run_round()
+    np.testing.assert_allclose(r_m["train_loss"], r_ref["train_loss"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(_flat(m.server_state.params),
+                               _flat(ref.server_state.params), atol=1e-5)
+
+
+def test_fednova_rejected_on_stateless_planes():
+    from colearn_federated_learning_tpu.fed import setup as setup_lib
+
+    with pytest.raises(NotImplementedError, match="fednova"):
+        setup_lib.require_stateless_strategy(_cfg(), "the socket worker")
+
+
+def test_fednova_momentum_coefficient():
+    # a_i for momentum SGD: tau=1 -> 1; tau -> infinity -> tau/(1-m).
+    import jax.numpy as jnp
+
+    m = 0.9
+    def a(tau):
+        tau = jnp.float32(tau)
+        return float((tau - m * (1 - m ** tau) / (1 - m)) / (1 - m))
+    np.testing.assert_allclose(a(1), 1.0, rtol=1e-5)
+    assert abs(a(200) - 200 / (1 - m)) / (200 / (1 - m)) < 0.05
+
+
+def test_fednova_rejects_adaptive_local_optimizers():
+    with pytest.raises(ValueError, match="geometric series"):
+        FederatedLearner(_cfg(local_optimizer="adam"))
