@@ -108,7 +108,10 @@ def decompress_delta(wire_tree: Any, meta: dict, shapes: Any = None) -> Any:
                 raise TypeError(
                     f"unexpected node {type(node).__name__} in topk tree"
                 )
-            flat = np.zeros(int(node[_N]), np.float32)
+            # _N may arrive off the wire as a 1-element array; plain int()
+            # on an ndim>0 array is deprecated (NumPy 2) and will raise.
+            n = int(np.asarray(node[_N]).ravel()[0])
+            flat = np.zeros(n, np.float32)
             flat[np.asarray(node[_I])] = np.asarray(node[_V], np.float32)
             return flat.reshape(np.asarray(ref).shape)
 

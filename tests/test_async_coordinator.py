@@ -235,3 +235,47 @@ def test_async_slow_device_does_not_stall():
         finally:
             for w in workers:
                 w.stop()
+
+
+def test_async_composes_with_topk_compression():
+    # Workers compress their deltas (native top-k selector); the async
+    # folder must decompress via the shared UpdateFolder plumbing.
+    cfg = _config(num_clients=3, compress="topk")
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            coord = AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=2,
+                want_evaluator=False,
+            )
+            # The wire payload really is top-k-compressed (not a silently
+            # dropped flag the folder would also accept).
+            header, wire = workers[0]._train(0, __import__("jax").tree.map(
+                np.asarray,
+                __import__(
+                    "colearn_federated_learning_tpu.fed.setup",
+                    fromlist=["setup"],
+                ).init_global_params(cfg),
+            ))
+            assert header["meta"]["compress"] == "topk"
+
+            def has_kleaf(d):                  # sparse index/value leaves
+                if isinstance(d, dict) and set(d) == {"i", "v", "n"}:
+                    return True
+                return isinstance(d, dict) and any(
+                    has_kleaf(v) for v in d.values()
+                )
+
+            assert has_kleaf(wire)
+
+            with coord:
+                coord.enroll(min_devices=3, timeout=20.0)
+                hist = coord.fit(aggregations=3)
+            assert len(hist) == 3
+            assert all(np.isfinite(r["train_loss"]) for r in hist)
+        finally:
+            for w in workers:
+                w.stop()
