@@ -1,3 +1,6 @@
 """Federated round engine: local training, server strategies, orchestration."""
 
 from colearn_federated_learning_tpu.fed.engine import FederatedLearner  # noqa: F401
+from colearn_federated_learning_tpu.fed.hierarchical import (  # noqa: F401
+    HierarchicalLearner,
+)

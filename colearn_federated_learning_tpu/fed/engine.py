@@ -81,10 +81,13 @@ def _rank_cohort(skey, counts, k):
 class FederatedLearner:
     """End-to-end federated experiment: data, model, round loop, eval.
 
-    ``mesh``: optional ``jax.sharding.Mesh`` with a single axis (named by
-    ``config.run.mesh_axis``); when given, client state is sharded along it
-    and aggregation runs as psum over the mesh.  When None, everything runs
-    on one device via vmap.
+    ``mesh``: optional ``jax.sharding.Mesh``.  The ``config.run.mesh_axis``
+    (clients) axis is required; a ``seq`` axis adds ring-attention sequence
+    parallelism, and a ``model`` axis adds GSPMD tensor/expert parallelism
+    (parallel/tp.py) — any combination up to the 3-D
+    (clients, seq, model) mesh.  Client state shards over the client axis
+    and aggregation runs as psum over it.  When None, everything runs on
+    one device via vmap.
     """
 
     @classmethod
@@ -96,8 +99,9 @@ class FederatedLearner:
         """Build a learner honoring ``config.run.backend`` (the CLI's
         ``--backend=tpu|cpu|auto``, BASELINE.json ``north_star``): resolve
         devices and lay clients over a 1-D mesh — or, with
-        ``attn_impl="ring"``, a 2-D (clients, seq) mesh where each client's
-        sequence dim is sharded over the inner (ICI-fastest) axis."""
+        ``attn_impl="ring"``, a 2-D (clients, seq) mesh, or, with
+        ``run.tp_size > 1``, a 2-D (clients, model) tensor-parallel
+        mesh."""
         from colearn_federated_learning_tpu.parallel.mesh import make_mesh
 
         devices = _resolve_devices(config.run.backend)
