@@ -85,7 +85,7 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of rounds 1-2 here")
     p.add_argument("--attn-impl", default=None,
-                   choices=["dense", "flash", "ring"],
+                   choices=["dense", "flash", "ring", "ulysses"],
                    help="attention core (models/attention.py)")
     p.add_argument("--remat", action="store_true", default=None,
                    help="rematerialize transformer blocks (jax.checkpoint): "

@@ -106,7 +106,7 @@ class FederatedLearner:
 
         devices = _resolve_devices(config.run.backend)
         r = config.run
-        if config.model.attn_impl == "ring" and r.tp_size > 1:
+        if config.model.attn_impl in ("ring", "ulysses") and r.tp_size > 1:
             raise ValueError(
                 "from_config cannot auto-lay a 3-D (clients, seq, model) "
                 "mesh; build it with parallel.mesh.make_mesh and pass "
@@ -122,7 +122,7 @@ class FederatedLearner:
                 stacklevel=2,
             )
         if len(devices) > 1:
-            if config.model.attn_impl == "ring":
+            if config.model.attn_impl in ("ring", "ulysses"):
                 mesh = make_mesh((r.mesh_axis, r.seq_axis), devices=devices)
             elif r.tp_size > 1 and len(devices) >= r.tp_size:
                 mesh = make_mesh((r.mesh_axis, r.tp_axis), (-1, r.tp_size),
@@ -170,14 +170,15 @@ class FederatedLearner:
             self.seq_size = 1
             self.tp_size = 1
         self.sp = self.seq_size > 1
-        if self.sp and c.model.attn_impl != "ring":
+        if self.sp and c.model.attn_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"a {self.seq_size}-way {self.seq_axis!r} mesh axis requires "
-                "model.attn_impl='ring'"
+                "model.attn_impl='ring' or 'ulysses'"
             )
-        if c.model.attn_impl == "ring" and mesh is not None and not self.sp:
+        if (c.model.attn_impl in ("ring", "ulysses") and mesh is not None
+                and not self.sp):
             raise ValueError(
-                "attn_impl='ring' on a mesh requires a "
+                f"attn_impl={c.model.attn_impl!r} on a mesh requires a "
                 f"{self.seq_axis!r} axis of size > 1"
             )
 
@@ -203,6 +204,16 @@ class FederatedLearner:
                 raise ValueError(
                     f"seq_len {seq_len} is not divisible by the "
                     f"{self.seq_size}-way {self.seq_axis!r} axis"
+                )
+            if (c.model.attn_impl == "ulysses"
+                    and c.model.num_heads % self.seq_size):
+                # Fail eagerly like the seq_len check above — the kernel's
+                # own guard would only fire deep inside the first trace.
+                raise ValueError(
+                    f"attn_impl='ulysses' needs num_heads "
+                    f"({c.model.num_heads}) divisible by the "
+                    f"{self.seq_size}-way {self.seq_axis!r} axis; use "
+                    "attn_impl='ring'"
                 )
         if mesh is not None:
             shards = pad_clients_to_multiple(shards, self.clients_size)

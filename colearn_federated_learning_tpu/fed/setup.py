@@ -20,13 +20,13 @@ from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 
 
 def local_model_config(model_cfg):
-    """Model config as seen by a SINGLE process (no mesh): ring attention
-    needs a shard_map sequence axis, so SP configs fall back to the dense
-    core — the param pytree is identical across cores, so checkpoints and
-    wire payloads stay compatible (models/attention.py)."""
+    """Model config as seen by a SINGLE process (no mesh): ring/ulysses
+    attention need a shard_map sequence axis, so SP configs fall back to
+    the dense core — the param pytree is identical across cores, so
+    checkpoints and wire payloads stay compatible (models/attention.py)."""
     import dataclasses
 
-    if model_cfg.attn_impl == "ring":
+    if model_cfg.attn_impl in ("ring", "ulysses"):
         return dataclasses.replace(model_cfg, attn_impl="dense")
     return model_cfg
 

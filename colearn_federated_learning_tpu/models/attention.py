@@ -10,6 +10,9 @@ depend on which core computes the softmax:
 - ``ring``:  sequence-parallel ring attention — REQUIRES being called
   inside ``shard_map`` with the sequence dim sharded over ``axis_name``
   (parallel/sp.py drives this).
+- ``ulysses``: sequence-parallel all-to-all attention (heads re-sharded
+  across the axis; parallel/ulysses.py) — same shard_map contract as
+  ``ring``, needs ``num_heads`` divisible by the axis size.
 
 Selected per-model via ``ModelConfig.attn_impl``.
 """
@@ -21,14 +24,14 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-ATTN_IMPLS = ("dense", "flash", "ring")
+ATTN_IMPLS = ("dense", "flash", "ring", "ulysses")
 
 
 class MultiHeadAttention(nn.Module):
     num_heads: int
     dtype: jnp.dtype = jnp.float32
     impl: str = "dense"
-    axis_name: Optional[str] = None   # mesh axis for impl="ring"
+    axis_name: Optional[str] = None   # mesh axis (impl="ring"/"ulysses")
     causal: bool = False
 
     @nn.compact
@@ -59,6 +62,16 @@ class MultiHeadAttention(nn.Module):
                 raise ValueError("impl='ring' needs axis_name (a mesh axis)")
             out = ring_attention(q, k, v, kv_mask, axis_name=self.axis_name,
                                  causal=self.causal)
+        elif self.impl == "ulysses":
+            from colearn_federated_learning_tpu.parallel.ulysses import (
+                ulysses_attention,
+            )
+
+            if not self.axis_name:
+                raise ValueError("impl='ulysses' needs axis_name (a mesh axis)")
+            out = ulysses_attention(q, k, v, kv_mask,
+                                    axis_name=self.axis_name,
+                                    causal=self.causal)
         else:
             raise ValueError(f"unknown attn impl {self.impl!r}; use {ATTN_IMPLS}")
 

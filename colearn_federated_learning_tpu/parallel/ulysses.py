@@ -1,0 +1,70 @@
+"""Ulysses-style sequence parallelism: all-to-all over attention heads.
+
+The second of the two standard long-context layouts (DeepSpeed-Ulysses,
+Jacobs et al. 2309.14509 — pattern only; ring attention in
+parallel/ring.py is the first):
+
+- inputs arrive SEQUENCE-sharded: each device holds (B, L/S, H, D);
+- one ``all_to_all`` re-shards to HEAD-sharded (B, L, H/S, D) — every
+  device now sees the FULL sequence for its head group;
+- plain dense attention runs locally (no cross-device softmax state at
+  all, unlike the ring's rotating online-softmax recurrence);
+- a second ``all_to_all`` restores sequence sharding.
+
+Trade-offs vs the ring: two all-to-alls of the whole activation per
+attention call instead of S ppermutes of K/V — cheaper when S is large
+and ICI all-to-all bandwidth is good, but it requires ``H % S == 0``
+(heads must split across the axis) while the ring has no head
+constraint.  Both compose with the same grad-pmean trainer convention
+(params replicated over ``seq``; fed/local.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from colearn_federated_learning_tpu.parallel.ring import dense_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: Optional[jax.Array] = None,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Attention with the sequence axis sharded over ``axis_name``.
+
+    Args/returns match :func:`parallel.ring.ring_attention`: local blocks
+    ``(B, L_local, H, D)`` in and out, optional ``(B, L_local)`` key
+    padding mask.  Must run inside ``shard_map`` with ``axis_name`` a
+    mesh axis of size S where ``H % S == 0``.
+    """
+    import jax.numpy as jnp
+
+    s = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % s != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({H}) divisible by the "
+            f"{axis_name!r} axis size ({s}); use attn_impl='ring' otherwise"
+        )
+
+    # ONE stacked collective for q/k/v instead of three — collective
+    # launch latency is per-call, and this runs every layer of every
+    # local step.  Stacked layout: (3, B, L/S, H, D).
+    qkv = jnp.stack([q, k, v])
+    qkv = lax.all_to_all(qkv, axis_name, split_axis=3, concat_axis=2,
+                         tiled=True)                 # (3, B, L, H/S, D)
+    mask_full = (
+        lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+        if kv_mask is not None else None
+    )
+    out = dense_attention(qkv[0], qkv[1], qkv[2], mask_full, causal=causal)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)                # (B, L/S, H, D)

@@ -35,7 +35,7 @@ class ModelConfig:
     seq_len: int = 128                # text models
     vocab_size: int = 30522           # BERT wordpiece vocab size
     dtype: str = "float32"            # compute dtype ("bfloat16" on TPU)
-    attn_impl: str = "dense"          # "dense" | "flash" (pallas) | "ring" (SP)
+    attn_impl: str = "dense"          # dense | flash (pallas) | ring/ulysses (SP)
     num_experts: int = 4              # MoE families (models/moe.py)
     moe_aux_weight: float = 0.01      # Switch load-balance loss weight
     # Rematerialize transformer blocks under autodiff (jax.checkpoint):
@@ -98,7 +98,7 @@ class RunConfig:
     seed: int = 0
     backend: str = "auto"             # "auto" | "tpu" | "cpu"  (CLI --backend)
     mesh_axis: str = "clients"
-    seq_axis: str = "seq"             # sequence-parallel axis (attn_impl="ring")
+    seq_axis: str = "seq"             # SP axis (attn_impl="ring"/"ulysses")
     tp_axis: str = "model"            # tensor/expert-parallel axis (parallel/tp.py)
     tp_size: int = 1                  # model-axis size for from_config meshes
     log_every: int = 1
