@@ -69,6 +69,13 @@ class AsyncFederatedCoordinator:
                 "and no async accountant is implemented; use the "
                 "synchronous coordinator for DP runs"
             )
+        if config.fed.secure_agg:
+            raise NotImplementedError(
+                "asynchronous aggregation with secure_agg is unsupported: "
+                "pairwise masks need an agreed per-round cohort, which the "
+                "per-device pumps don't have; use the synchronous "
+                "coordinator"
+            )
         setup_lib.require_mean_aggregator(config, "the async coordinator")
         self.config = config
         self.buffer_size = buffer_size

@@ -47,6 +47,17 @@ class FederatedCoordinator:
     ):
         setup_lib.require_mean_aggregator(config, "the socket coordinator")
         self.config = config
+        if config.fed.secure_agg and config.fed.secure_agg_neighbors and (
+            config.fed.secure_agg_neighbors % 2
+            or config.fed.secure_agg_neighbors < 2
+        ):
+            # Same eager check as the engine: a bad degree would otherwise
+            # error inside every worker's train handler and read as mass
+            # dropouts.
+            raise ValueError(
+                "secure_agg_neighbors must be an even integer >= 2, got "
+                f"{config.fed.secure_agg_neighbors}"
+            )
         self.round_timeout = round_timeout
         self.want_evaluator = want_evaluator
         self._broker = BrokerClient(broker_host, broker_port)
@@ -149,15 +160,26 @@ class FederatedCoordinator:
 
     def run_round(self) -> dict:
         """One federated round: broadcast → parallel local training with a
-        deadline → weighted aggregation of the updates that made it."""
+        deadline → weighted aggregation of the updates that made it.
+
+        With ``secure_agg`` the train request carries the round COHORT so
+        each worker can mask against its pairing partners; if any cohort
+        member drops, a follow-up ``unmask`` round collects the survivors'
+        orphaned mask halves (Bonawitz-pattern dropout recovery) before
+        the aggregate is usable."""
         r = len(self.history)
         cohort = self._sample_cohort(r)
         params_np = jax.tree.map(np.asarray, self.server_state.params)
         t0 = time.perf_counter()
+        secure = self.config.fed.secure_agg
+        cohort_ids = sorted(int(d.device_id) for d in cohort)
 
         def ask(dev: DeviceInfo):
+            req = {"op": "train", "round": r}
+            if secure:
+                req["cohort"] = cohort_ids
             header, delta = self._clients[dev.device_id].request(
-                {"op": "train", "round": r}, params_np,
+                req, params_np,
                 meta={"round": r}, timeout=self.round_timeout,
             )
             if header.get("status") != "ok":
@@ -186,14 +208,32 @@ class FederatedCoordinator:
         )
 
         folder = UpdateFolder(params_np)
+        received = []
         for meta, delta in results:
             if int(meta.get("round", r)) != r:       # stale update: refuse
                 dropped.append(str(meta.get("client_id")))
                 continue
             folder.add(meta, delta)
+            received.append(int(meta["client_id"]))
         folded = folder.count
 
+        unmask_failed = False
+        if secure and folded:
+            missing = sorted(set(cohort_ids) - set(received))
+            if missing:
+                unmask_failed = not self._unmask_dropped(
+                    r, cohort_ids, received, missing, folder
+                )
         mean_delta, total_w, mean_loss = folder.mean()
+        if unmask_failed:
+            # Orphaned mask halves would corrupt the aggregate; a no-op
+            # round is the safe failure (same convention as zero weight).
+            mean_delta = None
+            mean_loss = float("nan")
+        if secure:
+            # Workers omit per-client losses under secure aggregation (the
+            # per-client statistic is what the masks hide).
+            mean_loss = float("nan")
         if mean_delta is not None:
             self.server_state = strategies.server_update(
                 self.server_state, mean_delta, self.config.fed
@@ -209,14 +249,17 @@ class FederatedCoordinator:
             "total_weight": total_w,
             "round_time_s": time.perf_counter() - t0,
         }
+        if secure:
+            rec["unmask_failed"] = unmask_failed
         if self.accountant is not None:
             # Workers calibrate per-client noise to the NOMINAL cohort
             # (fed/setup.py finalize_client_delta), so with only ``folded``
             # contributors the realized central noise is
             # σ·C·sqrt(folded/nominal) — charge THAT, not nominal σ, or ε
             # under-reports whenever enrollment or completion falls short.
-            # A round that released no aggregate (folded == 0) costs nothing.
-            if folded > 0:
+            # A round that released no aggregate (folded == 0, or a
+            # discarded unmask failure) costs nothing.
+            if folded > 0 and not (secure and unmask_failed):
                 import math
 
                 nominal = max(
@@ -232,6 +275,57 @@ class FederatedCoordinator:
             rec["dp_delta"] = self.accountant.delta
         self.history.append(rec)
         return rec
+
+    def _unmask_dropped(self, r: int, cohort_ids, received, missing,
+                        folder) -> bool:
+        """Dropout-recovery round: every SURVIVOR returns the sum of the
+        pairwise masks it shared with the dropped peers; subtracting them
+        from the folded sum cancels the orphaned halves.  Returns False if
+        any survivor fails to answer (the round must then be discarded —
+        cascading recovery is out of scope for the honest-but-curious
+        demo).  Fans out with ONE shared deadline like the train phase
+        (sequential per-survivor timeouts would stack), and reconnects a
+        survivor whose unmask timed out so its late reply can't
+        desynchronise the next round's request/reply stream."""
+        from colearn_federated_learning_tpu.utils import pytrees
+
+        by_id = {int(d.device_id): d for d in self.trainers}
+        devs = []
+        for cid in received:
+            dev = by_id.get(cid)
+            if dev is None:
+                return False
+            devs.append(dev)
+
+        def ask(dev: DeviceInfo):
+            header, mask = self._clients[dev.device_id].request(
+                {"op": "unmask", "round": r, "dropped": missing,
+                 "cohort": cohort_ids},
+                None, timeout=self.round_timeout,
+            )
+            if header.get("status") != "ok":
+                raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
+            return header["meta"], mask
+
+        ok = True
+        deadline = time.perf_counter() + self.round_timeout
+        with cf.ThreadPoolExecutor(max_workers=max(1, len(devs))) as pool:
+            futs = {pool.submit(ask, d): d for d in devs}
+            for fut, dev in futs.items():
+                try:
+                    remaining = max(0.0, deadline - time.perf_counter())
+                    meta, mask = fut.result(timeout=remaining)
+                except Exception:
+                    fut.cancel()
+                    self._reconnect(dev)
+                    ok = False
+                    continue
+                if int(meta.get("n_dropped_pairs", 0)) == 0 or mask is None:
+                    continue
+                folder.wsum = pytrees.tree_sub(
+                    folder.wsum, jax.tree.map(np.asarray, mask)
+                )
+        return ok
 
     def evaluate(self) -> dict:
         """Score the global model on the evaluator device (SURVEY.md §3d)."""

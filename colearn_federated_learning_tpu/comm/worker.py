@@ -51,6 +51,19 @@ class DeviceWorker:
         self.client_id = int(client_id)
         c = config
         setup_lib.require_stateless_strategy(c, "the socket worker")
+        if c.fed.secure_agg and c.fed.secure_agg_neighbors and (
+            c.fed.secure_agg_neighbors % 2 or c.fed.secure_agg_neighbors < 2
+        ):
+            raise ValueError(
+                "secure_agg_neighbors must be an even integer >= 2, got "
+                f"{c.fed.secure_agg_neighbors}"
+            )
+        if c.fed.secure_agg and c.fed.compress != "none":
+            raise ValueError(
+                "secure_agg over the wire cannot compress: masked updates "
+                "are dense gaussian-scale payloads, and lossy compression "
+                "would break the pairwise mask cancellation"
+            )
 
         ds = dataset or data_registry.get_dataset(c.data.dataset,
                                                   seed=c.run.seed)
@@ -134,7 +147,12 @@ class DeviceWorker:
     def _handle(self, header: dict, tree: Any) -> tuple[dict, Any]:
         op = header.get("op")
         if op == "train":
-            return self._train(int(header.get("round", 0)), tree)
+            return self._train(int(header.get("round", 0)), tree,
+                               cohort=header.get("cohort"))
+        if op == "unmask":
+            return self._unmask(int(header.get("round", 0)),
+                                header.get("dropped", []),
+                                header.get("cohort", []), tree)
         if op == "eval":
             return self._eval(tree)
         if op == "info":
@@ -143,7 +161,22 @@ class DeviceWorker:
                               "num_steps": self._num_steps}}, None)
         return ({"status": "error", "error": f"unknown op {op!r}"}, None)
 
-    def _train(self, round_idx: int, global_params: Any) -> tuple[dict, Any]:
+    def _partner_row(self, round_idx: int, cohort: list):
+        """This client's secure-agg pairing partners for the round —
+        derived from the shared experiment seed exactly like the engine
+        (privacy/secure_agg.py), so no extra negotiation round is needed."""
+        from colearn_federated_learning_tpu.privacy import secure_agg as sa
+
+        cohort_ids = jnp.asarray(sorted(int(c) for c in cohort), jnp.int32)
+        table = sa.partner_table(
+            self._key, jnp.asarray([self.client_id], jnp.int32), cohort_ids,
+            jnp.asarray(round_idx, jnp.int32),
+            neighbors=self.config.fed.secure_agg_neighbors,
+        )
+        return table[0]
+
+    def _train(self, round_idx: int, global_params: Any,
+               cohort=None) -> tuple[dict, Any]:
         params = jax.tree.map(jnp.asarray, global_params)
         result = self._update_fn(
             params, self._x, self._y, self._count,
@@ -153,10 +186,29 @@ class DeviceWorker:
         delta, weight = setup_lib.finalize_client_delta(
             self.config, result, self.client_id, round_idx
         )
+        if self.config.fed.secure_agg:
+            if not cohort:
+                return ({"status": "error",
+                         "error": "secure_agg train request lacks the "
+                                  "round cohort"}, None)
+            # Masked aggregation is a plain SUM: uniform weighting, like
+            # the engine's secure path.
+            from colearn_federated_learning_tpu.privacy import secure_agg as sa
+
+            delta = sa.mask_update(
+                jax.tree.map(lambda l: l.astype(jnp.float32), delta),
+                self._key, jnp.asarray(self.client_id, jnp.int32),
+                self._partner_row(round_idx, cohort),
+                jnp.asarray(round_idx, jnp.int32),
+            )
+            weight = 1.0
         meta = {"round": round_idx, "weight": weight,
                 "client_id": self.client_id,
-                "num_examples": int(result.num_examples),
-                "mean_loss": float(result.mean_loss)}
+                "num_examples": int(result.num_examples)}
+        if not self.config.fed.secure_agg:
+            # Per-client loss is exactly the statistic the masks hide;
+            # ship it only on the unmasked plane.
+            meta["mean_loss"] = float(result.mean_loss)
         from colearn_federated_learning_tpu.fed import compression
 
         wire, cmeta = compression.compress_delta(
@@ -164,6 +216,42 @@ class DeviceWorker:
         )
         meta.update(cmeta)
         return ({"meta": meta}, wire)
+
+    def _unmask(self, round_idx: int, dropped: list, cohort: list,
+                _tree: Any) -> tuple[dict, Any]:
+        """Dropout recovery (Bonawitz pattern, honest-but-curious): return
+        the SUM of this client's pairwise masks shared with the dropped
+        peers it had paired with, exactly as it ADDED them — the
+        coordinator subtracts these to cancel the orphaned mask halves."""
+        from colearn_federated_learning_tpu.privacy import secure_agg as sa
+
+        partners = np.asarray(self._partner_row(round_idx, cohort))
+        mine = jnp.asarray(
+            [int(d) for d in dropped if int(d) in set(partners.tolist())],
+            jnp.int32,
+        )
+        template = jax.tree.map(
+            lambda l: jnp.zeros(l.shape, jnp.float32), self._template_params()
+        )
+        if mine.size == 0:
+            # No shared pairs with the dropped peers: a payload-free reply
+            # (shipping a model-sized zero tree would cost cohort x model
+            # bytes per dropout in ring mode).
+            return ({"meta": {"client_id": self.client_id,
+                              "n_dropped_pairs": 0}}, None)
+        mask = sa.pairwise_mask(
+            template, self._key,
+            jnp.asarray(self.client_id, jnp.int32), mine,
+            jnp.asarray(round_idx, jnp.int32),
+        )
+        return ({"meta": {"client_id": self.client_id,
+                          "n_dropped_pairs": int(mine.size)}},
+                jax.tree.map(np.asarray, mask))
+
+    def _template_params(self):
+        if not hasattr(self, "_param_template"):
+            self._param_template = setup_lib.init_global_params(self.config)
+        return self._param_template
 
     def _eval(self, global_params: Any) -> tuple[dict, Any]:
         if self._eval_fn is None:

@@ -321,3 +321,92 @@ def test_coordinator_checkpoint_kill_and_resume(tmp_path):
         finally:
             for w in workers:
                 w.stop()
+
+
+# ------------------------------------------- wire secure aggregation ----
+def test_socket_secure_agg_masks_cancel():
+    # Full participation: the coordinator's aggregate over MASKED wire
+    # updates must match a parallel unmasked federation (masks cancel in
+    # the sum; uniform weighting both sides since secure-agg forces it).
+    import jax
+
+    def run(secure):
+        cfg = _config(num_clients=3, secure_agg=secure)
+        with MessageBroker() as broker:
+            workers = [
+                DeviceWorker(cfg, i, broker.host, broker.port).start()
+                for i in range(3)
+            ]
+            try:
+                coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                             round_timeout=60.0,
+                                             want_evaluator=False)
+                coord.enroll(min_devices=3, timeout=20.0)
+                coord.fit(rounds=2)
+                return np.concatenate([
+                    np.ravel(np.asarray(a))
+                    for a in jax.tree.leaves(coord.server_state.params)
+                ])
+            finally:
+                for w in workers:
+                    w.stop()
+
+    masked, plain = run(True), run(False)
+    # Cancellation residual is float32-summation noise on ~1e-3 deltas.
+    np.testing.assert_allclose(masked, plain, atol=2e-4)
+
+
+def test_socket_secure_agg_dropout_recovery():
+    # One worker dies mid-federation: the unmask round must collect the
+    # survivors' orphaned mask halves, leaving a CLEAN aggregate of the
+    # survivors (== an unmasked survivors-only run).
+    import jax
+
+    cfg = _config(num_clients=3, secure_agg=True)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=8.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=3, timeout=20.0)
+            coord.run_round()                 # round 0: everyone healthy
+            workers[2].stop()                 # device "2" dies
+            rec = coord.run_round()           # round 1: dropout + unmask
+            assert "2" in rec["dropped"]
+            assert rec["completed"] == 2
+            masked = np.concatenate([
+                np.ravel(np.asarray(a))
+                for a in jax.tree.leaves(coord.server_state.params)
+            ])
+        finally:
+            for w in workers:
+                w.stop()
+
+    # Reference: unmasked federation where the same worker NEVER responds
+    # in round 1 (survivors-only aggregate).
+    cfg_plain = _config(num_clients=3, secure_agg=False)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg_plain, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg_plain, broker.host, broker.port,
+                                         round_timeout=8.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=3, timeout=20.0)
+            coord.run_round()
+            workers[2].stop()
+            coord.run_round()
+            plain = np.concatenate([
+                np.ravel(np.asarray(a))
+                for a in jax.tree.leaves(coord.server_state.params)
+            ])
+        finally:
+            for w in workers:
+                w.stop()
+    np.testing.assert_allclose(masked, plain, atol=2e-4)
