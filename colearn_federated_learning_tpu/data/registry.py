@@ -24,8 +24,9 @@ from colearn_federated_learning_tpu.data import synthetic
 @dataclasses.dataclass(frozen=True)
 class DatasetSpec:
     name: str
-    kind: str                      # "image" | "text"
-    input_shape: tuple[int, ...]   # per-example shape (image HWC or (seq_len,))
+    kind: str                      # "image" | "text" | "timeseries"
+    input_shape: tuple[int, ...]   # per-example shape: image HWC,
+                                   # text (seq_len,), timeseries (T, F)
     num_classes: int
     n_train: int                   # synthetic fallback sizes
     n_test: int
@@ -42,6 +43,13 @@ SPECS: dict[str, DatasetSpec] = {
     "mnist_tiny": DatasetSpec("mnist_tiny", "image", (28, 28, 1), 10, 2_000, 400),
     "cifar10_tiny": DatasetSpec("cifar10_tiny", "image", (32, 32, 3), 10, 2_000, 400),
     "agnews_tiny": DatasetSpec("agnews_tiny", "text", (64,), 4, 1_000, 200, vocab_size=2_000),
+    # IoT traffic windows (T, F) — the reference's ACTUAL task domain
+    # (network-anomaly detection at the edge, SURVEY.md §0); 8 classes =
+    # benign + 7 attack families.
+    "iot_traffic": DatasetSpec("iot_traffic", "timeseries", (64, 16), 8,
+                               40_000, 8_000),
+    "iot_traffic_tiny": DatasetSpec("iot_traffic_tiny", "timeseries",
+                                    (64, 16), 8, 2_000, 400),
 }
 
 
@@ -67,9 +75,19 @@ def _load_disk(spec: DatasetSpec) -> Dataset | None:
 
 
 def _make_synthetic(spec: DatasetSpec, seed: int) -> Dataset:
+    # proto_seed shared across splits: one class structure, disjoint draws.
+    proto_seed = 7919 * seed + zlib.crc32(spec.name.encode()) % 10_000
+    if spec.kind == "timeseries":
+        x_tr, y_tr = synthetic.synthetic_traffic_classification(
+            spec.n_train, spec.input_shape, spec.num_classes, seed=seed,
+            proto_seed=proto_seed,
+        )
+        x_te, y_te = synthetic.synthetic_traffic_classification(
+            spec.n_test, spec.input_shape, spec.num_classes, seed=seed + 1,
+            proto_seed=proto_seed,
+        )
+        return Dataset(spec, x_tr, y_tr, x_te, y_te, "synthetic")
     if spec.kind == "image":
-        # proto_seed shared across splits: one class structure, disjoint draws.
-        proto_seed = 7919 * seed + zlib.crc32(spec.name.encode()) % 10_000
         x_tr, y_tr = synthetic.synthetic_image_classification(
             spec.n_train, spec.input_shape, spec.num_classes, seed=seed,
             proto_seed=proto_seed,

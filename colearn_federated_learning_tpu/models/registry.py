@@ -64,6 +64,11 @@ def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
                               attn_impl=cfg.attn_impl,
                               seq_axis_name=seq_axis_name,
                               num_experts=cfg.num_experts, remat=cfg.remat)
+    if cfg.name == "tcn":
+        from colearn_federated_learning_tpu.models.tcn import TCN
+
+        return TCN(num_classes=cfg.num_classes, width=cfg.width,
+                   depth=cfg.depth, dtype=dtype)
     if cfg.name == "vit_b16":
         from colearn_federated_learning_tpu.models.vit import ViT
 

@@ -1,0 +1,55 @@
+"""Temporal convolutional network for IoT traffic windows.
+
+The reference's models are "small nets for anomaly detection" on IoT
+network traffic (SURVEY.md §0/§2) — this is that family, TPU-first:
+dilated 1-D convolutions (Bai et al. TCN pattern — receptive field grows
+exponentially with depth) whose channel dims are MXU matmuls, GroupNorm
+(no batch statistics — federated clients must not share normalization
+state), residual blocks, masked-free static shapes.  Input: (B, T, F)
+feature windows (rolling flow statistics); output: attack-family logits.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TCNBlock(nn.Module):
+    channels: int
+    dilation: int
+    kernel: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        """x: (B, T, C) — 'SAME' padding keeps T static across blocks."""
+        h = nn.Conv(self.channels, (self.kernel,),
+                    kernel_dilation=(self.dilation,), padding="SAME",
+                    dtype=self.dtype)(x)
+        h = nn.GroupNorm(num_groups=min(8, self.channels),
+                         dtype=self.dtype)(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.channels, (self.kernel,),
+                    kernel_dilation=(self.dilation,), padding="SAME",
+                    dtype=self.dtype)(h)
+        h = nn.GroupNorm(num_groups=min(8, self.channels),
+                         dtype=self.dtype)(h)
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1,), dtype=self.dtype)(x)
+        return nn.relu(x + h)
+
+
+class TCN(nn.Module):
+    num_classes: int = 8
+    width: int = 64
+    depth: int = 4                    # dilations 1, 2, 4, ... 2^(depth-1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for i in range(self.depth):
+            x = TCNBlock(self.width, dilation=2 ** i, dtype=self.dtype)(x)
+        pooled = jnp.mean(x.astype(jnp.float32), axis=1)   # (B, C)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)

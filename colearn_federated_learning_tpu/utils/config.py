@@ -179,6 +179,20 @@ CONFIGS: dict[str, ExperimentConfig] = {
 }
 
 
+# Thematic parity config beyond the five BASELINE entries: the
+# reference's ACTUAL deployment task — IoT network-anomaly detection on
+# edge devices (SURVEY.md §0) — as a federated TCN over traffic windows.
+CONFIGS["iot_traffic_tcn_fedavg"] = _cfg(
+    data=DataConfig(dataset="iot_traffic", num_clients=50,
+                    partition="dirichlet", dirichlet_alpha=0.3),
+    model=ModelConfig(name="tcn", num_classes=8, width=64, depth=4,
+                      dtype="bfloat16"),
+    fed=FedConfig(strategy="fedavg", rounds=50, cohort_size=10,
+                  local_epochs=1, batch_size=32, lr=0.05, momentum=0.9),
+    run=RunConfig(name="iot_traffic_tcn_fedavg"),
+)
+
+
 def get_config(name: str) -> ExperimentConfig:
     if name not in CONFIGS:
         raise KeyError(f"unknown config {name!r}; available: {sorted(CONFIGS)}")
