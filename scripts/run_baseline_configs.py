@@ -24,6 +24,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # Honor a CPU request even on hosts whose sitecustomize pins an
+    # accelerator platform (env alone doesn't override it, and a dead
+    # remote-TPU tunnel HANGS inside jax.devices()).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def scaled_variants():
     """name -> (scaled ExperimentConfig, note)."""
@@ -73,6 +81,16 @@ def scaled_variants():
     c = c.replace(run=dataclasses.replace(c.run, name="agnews_moebert"))
     out["agnews_moebert_fedavg"] = (
         c, "MoE superset: 4 experts every other block, top-2 routing")
+
+    # Thematic parity config: the reference's actual IoT anomaly task.
+    c = get_config("iot_traffic_tcn_fedavg")
+    c = c.replace(
+        data=dataclasses.replace(c.data, dataset="iot_traffic",
+                                 max_examples_per_client=128),
+        fed=dataclasses.replace(c.fed, rounds=25),
+    )
+    out["iot_traffic_tcn_fedavg"] = (
+        c, "full TCN; 25 rounds, 128 ex/client")
 
     c = get_config("femnist_vit_cross_silo")
     c = c.replace(
