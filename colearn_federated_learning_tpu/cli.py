@@ -319,6 +319,8 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
         hist = coord.fit(log_fn=lambda rec: print(json.dumps(rec),
                                                   file=sys.stderr),
                          elastic=args.elastic)
+        if args.per_client_eval:
+            print(json.dumps(coord.evaluate_per_client()), file=sys.stderr)
         print(json.dumps(hist[-1]))
     return 0
 
@@ -411,6 +413,9 @@ def main(argv: list[str] | None = None) -> int:
     p_coord.add_argument("--resume", action="store_true",
                          help="restore the latest checkpoint from "
                               "--checkpoint-dir before training")
+    p_coord.add_argument("--per-client-eval", action="store_true",
+                         help="report each trainer's own-shard accuracy "
+                              "after training (worker self_eval op)")
     p_coord.add_argument("--async-buffer", type=int, default=0,
                          help="> 0 switches to buffered-asynchronous "
                               "aggregation (FedBuff-style): apply the "

@@ -150,6 +150,26 @@ class FederatedCoordinator:
         except OSError:
             pass                                      # dead peer: keep closed
 
+    def _fan_out(self, devs, ask):
+        """Fan ``ask`` out over ``devs`` racing ONE shared round_timeout
+        deadline (sequential per-future timeouts would stack).  Failures
+        are cancelled and the device's socket is RECONNECTED — a late
+        reply on the old socket would desynchronise the request/reply
+        stream.  Returns (results, failed_devices)."""
+        results, failed = [], []
+        deadline = time.perf_counter() + self.round_timeout
+        with cf.ThreadPoolExecutor(max_workers=max(1, len(devs))) as pool:
+            futs = {pool.submit(ask, d): d for d in devs}
+            for fut, dev in futs.items():
+                try:
+                    remaining = max(0.0, deadline - time.perf_counter())
+                    results.append(fut.result(timeout=remaining))
+                except Exception:
+                    fut.cancel()
+                    failed.append(dev)
+                    self._reconnect(dev)
+        return results, failed
+
     def _sample_cohort(self, round_idx: int) -> list[DeviceInfo]:
         k = self.config.fed.cohort_size
         if not k or k >= len(self.trainers):
@@ -186,22 +206,8 @@ class FederatedCoordinator:
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"], delta
 
-        results, dropped = [], []
-        # ONE deadline for the whole round: every future races the same
-        # clock, so a bad round costs round_timeout, not cohort × timeout
-        # (the requests run concurrently; sequential per-future timeouts
-        # would stack while collecting).
-        deadline = t0 + self.round_timeout
-        with cf.ThreadPoolExecutor(max_workers=max(1, len(cohort))) as pool:
-            futs = {pool.submit(ask, d): d for d in cohort}
-            for fut, dev in futs.items():
-                try:
-                    remaining = max(0.0, deadline - time.perf_counter())
-                    results.append(fut.result(timeout=remaining))
-                except Exception:                     # timeout / dead peer
-                    fut.cancel()
-                    dropped.append(dev.device_id)
-                    self._reconnect(dev)
+        results, failed = self._fan_out(cohort, ask)
+        dropped = [d.device_id for d in failed]
 
         from colearn_federated_learning_tpu.comm.aggregation import (
             UpdateFolder,
@@ -307,25 +313,50 @@ class FederatedCoordinator:
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"], mask
 
-        ok = True
-        deadline = time.perf_counter() + self.round_timeout
-        with cf.ThreadPoolExecutor(max_workers=max(1, len(devs))) as pool:
-            futs = {pool.submit(ask, d): d for d in devs}
-            for fut, dev in futs.items():
-                try:
-                    remaining = max(0.0, deadline - time.perf_counter())
-                    meta, mask = fut.result(timeout=remaining)
-                except Exception:
-                    fut.cancel()
-                    self._reconnect(dev)
-                    ok = False
-                    continue
-                if int(meta.get("n_dropped_pairs", 0)) == 0 or mask is None:
-                    continue
-                folder.wsum = pytrees.tree_sub(
-                    folder.wsum, jax.tree.map(np.asarray, mask)
-                )
-        return ok
+        results, failed = self._fan_out(devs, ask)
+        for meta, mask in results:
+            if int(meta.get("n_dropped_pairs", 0)) == 0 or mask is None:
+                continue
+            folder.wsum = pytrees.tree_sub(
+                folder.wsum, jax.tree.map(np.asarray, mask)
+            )
+        return not failed
+
+    def evaluate_per_client(self) -> dict:
+        """Score the CURRENT global model on every trainer's own shard
+        (the engine's ``evaluate_per_client`` over the wire): fan-out
+        ``self_eval`` requests, one shared deadline; devices that fail are
+        skipped.  Returns weighted aggregates plus the accuracy spread."""
+        if self.config.fed.secure_agg:
+            raise NotImplementedError(
+                "per-client evaluation is disabled under secure_agg: "
+                "per-client statistics are exactly what the masks hide"
+            )
+        params_np = jax.tree.map(np.asarray, self.server_state.params)
+
+        def ask(dev: DeviceInfo):
+            header, _ = self._clients[dev.device_id].request(
+                {"op": "self_eval"}, params_np, timeout=self.round_timeout,
+            )
+            if header.get("status") != "ok":
+                raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
+            return header["meta"]
+
+        metas, _ = self._fan_out(self.trainers, ask)
+        if not metas:
+            return {"num_clients_evaluated": 0}
+        from colearn_federated_learning_tpu.fed.evaluation import (
+            summarize_per_client,
+        )
+
+        out = summarize_per_client(
+            [m["self_loss"] for m in metas],
+            [m["self_acc"] for m in metas],
+            [m["num_examples"] for m in metas],
+        )
+        out["num_clients_evaluated"] = len(metas)
+        out["per_client"] = {m["client_id"]: m["self_acc"] for m in metas}
+        return out
 
     def evaluate(self) -> dict:
         """Score the global model on the evaluator device (SURVEY.md §3d)."""

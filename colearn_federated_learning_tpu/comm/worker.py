@@ -9,9 +9,13 @@ vmaps), serves ``train`` / ``eval`` requests over the tensor plane, and
 enrolls itself on the control plane.
 
 Requests:
-  {"op": "train", "round": r} + global params  →  delta + meta{weight,...}
-  {"op": "eval"}              + global params  →  meta{eval_loss, eval_acc}
-  {"op": "info"}                               →  meta{num_examples, ...}
+  {"op": "train", "round": r[, "cohort"]} + params → delta + meta{weight,...}
+  {"op": "eval"}      + global params  →  meta{eval_loss, eval_acc}
+  {"op": "self_eval"} + global params  →  meta{self_loss, self_acc, ...}
+                                           (disabled under secure_agg)
+  {"op": "unmask", "round", "dropped", "cohort"} → summed pair masks vs the
+                                           dropped peers (dropout recovery)
+  {"op": "info"}                       →  meta{num_examples, ...}
 """
 
 from __future__ import annotations
@@ -155,6 +159,8 @@ class DeviceWorker:
                                 header.get("cohort", []), tree)
         if op == "eval":
             return self._eval(tree)
+        if op == "self_eval":
+            return self._self_eval(tree)
         if op == "info":
             return ({"meta": {"client_id": self.client_id,
                               "num_examples": self.num_examples,
@@ -252,6 +258,33 @@ class DeviceWorker:
         if not hasattr(self, "_param_template"):
             self._param_template = setup_lib.init_global_params(self.config)
         return self._param_template
+
+    def _self_eval(self, global_params: Any) -> tuple[dict, Any]:
+        """Score the global model on THIS device's own shard — the
+        federated-native complement of the evaluator role (the engine's
+        ``evaluate_per_client``): how well the global model fits each
+        client's local distribution under non-IID partitions."""
+        if self.config.fed.secure_agg:
+            # Per-client statistics are exactly what the masks hide; the
+            # device refuses regardless of who asks.
+            return ({"status": "error",
+                     "error": "self_eval is disabled under secure_agg"},
+                    None)
+        from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
+
+        if not hasattr(self, "_self_eval_fn"):
+            n = self.num_examples
+            self._self_eval_fn = make_eval_fn(
+                self._model.apply,
+                np.asarray(self._x[:n]), np.asarray(self._y[:n]),
+                batch=max(self.config.fed.batch_size, 64),
+            )
+        params = jax.tree.map(jnp.asarray, global_params)
+        loss, acc = self._self_eval_fn(params)
+        return ({"meta": {"client_id": self.client_id,
+                          "num_examples": self.num_examples,
+                          "self_loss": float(loss),
+                          "self_acc": float(acc)}}, None)
 
     def _eval(self, global_params: Any) -> tuple[dict, Any]:
         if self._eval_fn is None:

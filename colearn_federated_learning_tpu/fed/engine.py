@@ -1022,17 +1022,14 @@ class FederatedLearner:
         loss, acc, counts = loss[order], acc[order], counts[order]
         real = counts > 0
         loss, acc, counts = loss[real], acc[real], counts[real]
-        w = counts / counts.sum()
-        return {
-            "per_client_loss": loss,
-            "per_client_acc": acc,
-            "num_examples": counts,
-            "weighted_loss": float((loss * w).sum()),
-            "weighted_acc": float((acc * w).sum()),
-            "acc_p10": float(np.percentile(acc, 10)),
-            "acc_p50": float(np.percentile(acc, 50)),
-            "acc_p90": float(np.percentile(acc, 90)),
-        }
+        from colearn_federated_learning_tpu.fed.evaluation import (
+            summarize_per_client,
+        )
+
+        out = summarize_per_client(loss, acc, counts)
+        out.update(per_client_loss=loss, per_client_acc=acc,
+                   num_examples=counts)
+        return out
 
     # ---- personalized evaluation (fine-tune-then-eval) ----------------
     def evaluate_personalized(self, steps: int = 5,

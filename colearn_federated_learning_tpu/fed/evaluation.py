@@ -54,3 +54,22 @@ def make_eval_fn(apply_fn: Callable, x_test, y_test, batch: int) -> Callable:
         return loss_sum / m_sum, acc_sum / m_sum
 
     return eval_fn
+
+
+def summarize_per_client(losses, accs, counts) -> dict:
+    """Example-weighted aggregates + accuracy spread over per-client
+    scores — ONE definition shared by the engine's vmapped per-client
+    eval and the socket coordinator's wire-plane fan-out."""
+    import numpy as np
+
+    losses = np.asarray(losses, np.float64)
+    accs = np.asarray(accs, np.float64)
+    counts = np.asarray(counts, np.float64)
+    w = counts / counts.sum()
+    return {
+        "weighted_loss": float((losses * w).sum()),
+        "weighted_acc": float((accs * w).sum()),
+        "acc_p10": float(np.percentile(accs, 10)),
+        "acc_p50": float(np.percentile(accs, 50)),
+        "acc_p90": float(np.percentile(accs, 90)),
+    }

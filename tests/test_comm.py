@@ -410,3 +410,32 @@ def test_socket_secure_agg_dropout_recovery():
             for w in workers:
                 w.stop()
     np.testing.assert_allclose(masked, plain, atol=2e-4)
+
+
+def test_socket_per_client_evaluation():
+    # Non-IID partition: the coordinator's wire-plane per-client eval
+    # (worker self_eval op) must report a real accuracy spread.
+    import dataclasses
+
+    cfg = _config(num_clients=4)
+    cfg = cfg.replace(data=dataclasses.replace(
+        cfg.data, partition="dirichlet", dirichlet_alpha=0.2))
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(4)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=4, timeout=20.0)
+            coord.fit(rounds=3)
+            rep = coord.evaluate_per_client()
+            assert rep["num_clients_evaluated"] == 4
+            assert len(rep["per_client"]) == 4
+            assert 0.0 <= rep["acc_p10"] <= rep["acc_p50"] <= rep["acc_p90"] <= 1.0
+            assert rep["weighted_acc"] > 0.5       # trained model
+        finally:
+            for w in workers:
+                w.stop()
