@@ -1,0 +1,56 @@
+"""Cross-feature smoke matrix: one engine round per flag combination.
+
+Each dedicated test file covers its feature in depth; this matrix pins the
+COMPOSITIONS — pairs that share engine plumbing but no dedicated test.
+One tiny round each (compile-cached MLP), asserting a finite loss and a
+real contribution.
+"""
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+COMBOS = {
+    "fedprox_straggler_dp": dict(strategy="fedprox", prox_mu=0.01,
+                                 straggler_prob=0.3, dp_clip=1.0,
+                                 dp_noise_multiplier=0.2),
+    "fednova_median": dict(strategy="fednova", aggregator="median",
+                           straggler_prob=0.3,
+                           straggler_min_fraction=0.01),
+    "fednova_secure_agg": dict(strategy="fednova", secure_agg=True),
+    "fedyogi_trimmed": dict(strategy="fedyogi", aggregator="trimmed_mean",
+                            trim_fraction=0.25),
+    "adaptive_clip_stragglers": dict(dp_clip=10.0, dp_adaptive_clip=True,
+                                     straggler_prob=0.4,
+                                     straggler_min_fraction=0.01),
+    "krum_cohort_sampling": dict(aggregator="krum", trim_fraction=0.25,
+                                 cohort_size=6),
+    "secure_ring_dp": dict(secure_agg=True, secure_agg_neighbors=2,
+                           dp_clip=1.0, dp_noise_multiplier=0.2),
+    "fedadam_cohort": dict(strategy="fedadam", cohort_size=4),
+}
+
+
+@pytest.mark.parametrize("name", sorted(COMBOS))
+def test_feature_combo_runs_one_round(name):
+    fed = dict(strategy="fedavg", rounds=1, cohort_size=0, local_steps=2,
+               batch_size=8, lr=0.1, momentum=0.0)
+    fed.update(COMBOS[name])
+    learner = FederatedLearner(ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=8, partition="iid",
+                        max_examples_per_client=32),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(**fed),
+        run=RunConfig(name=f"matrix_{name}"),
+    ))
+    rec = learner.run_round()
+    assert np.isfinite(rec["train_loss"]), (name, rec)
+    assert rec["completed"] >= 1, (name, rec)
