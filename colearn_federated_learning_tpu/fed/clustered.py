@@ -1,0 +1,156 @@
+"""Clustered federated learning: per-concept models from update similarity.
+
+When client populations carry CONFLICTING concepts (e.g. the same traffic
+pattern is benign on one fleet and an attack on another), no single global
+model fits everyone — the classic failure FedAvg cannot see.  Clustered FL
+(Sattler et al. 1910.01991 / IFCA lineage, pattern only) recovers the
+latent grouping from the geometry of the clients' OWN updates and trains
+one model per cluster:
+
+1. warm up a global model a few rounds;
+2. compute the (N, N) cosine-similarity matrix of per-client updates —
+   one vmapped jit program + one MXU gram matmul
+   (``FederatedLearner.client_update_similarity``);
+3. cluster its rows (k-means on host; the matrix is tiny);
+4. build one ``FederatedLearner`` per cluster over its members' packed
+   shards, seeded from the warmed-up global model, and train them
+   independently.
+
+Evaluation is per-client on the members' OWN shards (the global holdout
+carries only one concept, so it cannot score concept-shifted clusters).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+
+
+def kmeans_rows(X: np.ndarray, k: int, iters: int = 50,
+                seed: int = 0) -> np.ndarray:
+    """Tiny k-means (numpy, k-means++ init) over the rows of ``X``."""
+    rng = np.random.default_rng(seed)
+    n = X.shape[0]
+    centers = [X[rng.integers(n)]]
+    for _ in range(1, k):
+        d2 = np.min(
+            [np.sum((X - c) ** 2, axis=1) for c in centers], axis=0
+        )
+        total = d2.sum()
+        if total <= 0.0:
+            # Degenerate: all rows identical — any choice is equivalent.
+            centers.append(X[rng.integers(n)])
+            continue
+        centers.append(X[rng.choice(n, p=d2 / total)])
+    C = np.stack(centers)
+    labels = np.zeros(n, np.int32)
+    for _ in range(iters):
+        d = ((X[:, None, :] - C[None]) ** 2).sum(-1)
+        new = d.argmin(1).astype(np.int32)
+        if (new == labels).all():
+            break
+        labels = new
+        for j in range(k):
+            if (labels == j).any():
+                C[j] = X[labels == j].mean(0)
+    return labels
+
+
+class ClusteredLearner:
+    """Warm up → cluster by update similarity → one learner per cluster.
+
+    Built ON an existing single-device ``FederatedLearner`` (its packed
+    shards are the ground truth of who owns which examples, so tests can
+    manipulate per-client data before clustering).
+    """
+
+    def __init__(self, base: FederatedLearner, num_clusters: int = 2):
+        if base.mesh is not None:
+            raise NotImplementedError("cluster on the vmap path")
+        if num_clusters < 2:
+            raise ValueError(f"num_clusters must be >= 2, got {num_clusters}")
+        self.base = base
+        self.num_clusters = num_clusters
+        self.labels: Optional[np.ndarray] = None
+        self.clusters: list[FederatedLearner] = []
+        self.members: list[np.ndarray] = []
+
+    def cluster_and_specialize(self, warmup_rounds: int = 2,
+                               sim_steps: int = 3) -> np.ndarray:
+        """Run the pipeline; returns the per-client cluster labels."""
+        import dataclasses
+
+        base = self.base
+        self.clusters, self.members = [], []   # re-clustering resets state
+        for _ in range(warmup_rounds):
+            base.run_round()
+        sim = base.client_update_similarity(steps=sim_steps)
+        self.labels = kmeans_rows(sim, self.num_clusters,
+                                  seed=base.config.run.seed)
+
+        # One learner per cluster over its members' EXACT shard rows:
+        # examples concatenate per member in order and explicit contiguous
+        # partitions are injected, so every member keeps its own shard
+        # (and non-IID skew) inside its cluster learner.
+        x = np.asarray(base._device_data[0])
+        y = np.asarray(base._device_data[1])   # tests may have edited y
+        counts = np.asarray(base.shards.counts)
+        for j in range(self.num_clusters):
+            members = np.where(self.labels == j)[0]
+            self.members.append(members)
+            if members.size == 0:
+                self.clusters.append(None)
+                continue
+            xs = np.concatenate([x[i][: counts[i]] for i in members])
+            ys = np.concatenate([y[i][: counts[i]] for i in members])
+            offsets = np.cumsum([0] + [int(counts[i]) for i in members])
+            parts = [np.arange(offsets[m], offsets[m + 1])
+                     for m in range(members.size)]
+            ds = dataclasses.replace(
+                base.dataset, x_train=xs, y_train=ys,
+            )
+            cfg = base.config.replace(
+                data=dataclasses.replace(
+                    base.config.data, num_clients=int(members.size),
+                ),
+                run=dataclasses.replace(
+                    base.config.run,
+                    name=f"{base.config.run.name}_cluster{j}",
+                ),
+            )
+            learner = FederatedLearner(cfg, dataset=ds, partitions=parts)
+            learner.server_state = learner.server_state._replace(
+                params=base.server_state.params
+            )
+            self.clusters.append(learner)
+        return self.labels
+
+    def fit(self, rounds: int) -> None:
+        if self.labels is None:
+            raise RuntimeError("call cluster_and_specialize() first")
+        for learner in self.clusters:
+            if learner is not None:
+                learner.fit(rounds=rounds)
+
+    def evaluate_per_client(self) -> dict:
+        """Per-client accuracy of each cluster's model on its members'
+        OWN shards, plus the weighted aggregate across all clusters."""
+        from colearn_federated_learning_tpu.fed.evaluation import (
+            summarize_per_client,
+        )
+
+        losses, accs, counts = [], [], []
+        for learner in self.clusters:
+            if learner is None:
+                continue
+            rep = learner.evaluate_per_client()
+            losses.extend(rep["per_client_loss"])
+            accs.extend(rep["per_client_acc"])
+            counts.extend(rep["num_examples"])
+        out = summarize_per_client(losses, accs, counts)
+        out["num_clusters"] = sum(c is not None for c in self.clusters)
+        out["cluster_sizes"] = [int(m.size) for m in self.members]
+        return out

@@ -136,7 +136,12 @@ class FederatedLearner:
         config: ExperimentConfig,
         dataset: Optional[data_registry.Dataset] = None,
         mesh: Optional[Mesh] = None,
+        partitions: Optional[list] = None,
     ):
+        """``partitions``: optional explicit per-client index lists into the
+        dataset's train split, overriding ``config.data.partition`` —
+        callers that already know exactly who owns which rows (clustered
+        FL preserving member shards) inject them here."""
         self.config = config
         self.mesh = mesh
         c = config
@@ -187,7 +192,8 @@ class FederatedLearner:
             c.data.dataset, seed=c.run.seed
         )
         labels = np.asarray(self.dataset.y_train)
-        parts = setup_lib.partition_for_config(c, labels)
+        parts = (partitions if partitions is not None
+                 else setup_lib.partition_for_config(c, labels))
         shards = pack_client_shards(
             np.asarray(self.dataset.x_train), labels, parts,
             capacity=c.data.max_examples_per_client,
@@ -1030,6 +1036,55 @@ class FederatedLearner:
         out.update(per_client_loss=loss, per_client_acc=acc,
                    num_examples=counts)
         return out
+
+    # ---- client update similarity (clustered FL) ----------------------
+    def client_update_similarity(self, steps: int = 1) -> np.ndarray:
+        """(N, N) cosine similarity of every client's local update from
+        the CURRENT global model — the clustering signal of clustered FL
+        (fed/clustered.py): clients drawn from the same concept produce
+        aligned updates, concept-shifted clients anti-align.
+
+        One jit program: vmapped local steps over ALL clients, flatten,
+        one gram matmul (MXU) — the (N, P) matrix never leaves the device;
+        only the (N, N) similarity does.  vmap path only (cross-device
+        gram over a mesh would move every delta anyway).
+        """
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "client_update_similarity runs on the single-device vmap "
+                "path; build the learner without a mesh for clustering"
+            )
+        if self.scaffold:
+            raise NotImplementedError(
+                "clustering uses the plain local trainer; run it with a "
+                "stateless strategy"
+            )
+        if getattr(self, "_sim_key", None) != steps:
+            self._sim_key = steps
+            budget = jnp.asarray(min(steps, self.num_steps), jnp.int32)
+
+            def sim(params, x, y, counts, ids, key):
+                keys = jax.vmap(
+                    lambda i: prng.client_round_key(key, i, 1 << 23)
+                )(ids)
+                budgets = jnp.full((self.num_clients,), budget, jnp.int32)
+                res = jax.vmap(self.local_update,
+                               in_axes=(None, 0, 0, 0, 0, 0))(
+                    params, x, y, counts, keys, budgets
+                )
+                X = jnp.concatenate(
+                    [l.reshape(self.num_clients, -1).astype(jnp.float32)
+                     for l in jax.tree.leaves(res.delta)], axis=1,
+                )
+                Xn = X / jnp.maximum(
+                    jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12
+                )
+                return Xn @ Xn.T
+
+            self._sim_fn = jax.jit(sim)
+        return np.asarray(self._sim_fn(
+            self.server_state.params, *self._device_data, self.base_key
+        ))
 
     # ---- personalized evaluation (fine-tune-then-eval) ----------------
     def evaluate_personalized(self, steps: int = 5,
