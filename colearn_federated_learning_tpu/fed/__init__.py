@@ -4,3 +4,6 @@ from colearn_federated_learning_tpu.fed.engine import FederatedLearner  # noqa: 
 from colearn_federated_learning_tpu.fed.hierarchical import (  # noqa: F401
     HierarchicalLearner,
 )
+from colearn_federated_learning_tpu.fed.clustered import (  # noqa: F401
+    ClusteredLearner,
+)
