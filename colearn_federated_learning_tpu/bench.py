@@ -269,7 +269,7 @@ def main(argv: list[str] | None = None) -> None:
             "error": err,
         }))
         return
-    print(json.dumps({
+    out = {
         "metric": "fedavg_cifar10_cnn_rounds_per_sec",
         "value": round(ours["rounds_per_sec"], 4),
         "unit": "rounds/sec",
@@ -279,7 +279,18 @@ def main(argv: list[str] | None = None) -> None:
         "rounds_timed": ours.get("rounds_timed", args.rounds),
         "client_samples_per_sec_per_chip": round(
             ours["client_samples_per_sec_per_chip"], 1),
-    }))
+    }
+    if ours["platform"] == "cpu":
+        # The fallback exists so a dead accelerator still yields a record;
+        # its ratio reflects XLA:CPU vs torch-MKLDNN conv throughput, not
+        # the framework (the TPU number is the headline — PERF.md §3:
+        # 14.78 rounds/sec, ~1300x the reference-style baseline).
+        why = ("--force-cpu" if args.force_cpu
+               else "accelerator unreachable")
+        out["note"] = (f"cpu fallback ({why}): ratio is "
+                       "XLA:CPU-vs-MKLDNN backend throughput; see PERF.md "
+                       "for the measured TPU numbers")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
