@@ -81,15 +81,26 @@ class ClusteredLearner:
     def cluster_and_specialize(self, warmup_rounds: int = 2,
                                sim_steps: int = 3) -> np.ndarray:
         """Run the pipeline; returns the per-client cluster labels."""
-        import dataclasses
-
         base = self.base
-        self.clusters, self.members = [], []   # re-clustering resets state
         for _ in range(warmup_rounds):
             base.run_round()
         sim = base.client_update_similarity(steps=sim_steps)
-        self.labels = kmeans_rows(sim, self.num_clusters,
-                                  seed=base.config.run.seed)
+        labels = kmeans_rows(sim, self.num_clusters,
+                             seed=base.config.run.seed)
+        self._build_clusters(labels,
+                             [base.server_state.params] * self.num_clusters)
+        return self.labels
+
+    def _build_clusters(self, labels: np.ndarray, init_params: list) -> None:
+        """(Re)build the per-cluster learners for ``labels``, seeding
+        cluster ``j`` from ``init_params[j]`` — the warm global model on
+        first clustering, each cluster's own trained model on IFCA
+        reassignment."""
+        import dataclasses
+
+        base = self.base
+        self.labels = labels
+        self.clusters, self.members = [], []   # re-clustering resets state
 
         # One learner per cluster over its members' EXACT shard rows:
         # examples concatenate per member in order and explicit contiguous
@@ -99,7 +110,7 @@ class ClusteredLearner:
         y = np.asarray(base._device_data[1])   # tests may have edited y
         counts = np.asarray(base.shards.counts)
         for j in range(self.num_clusters):
-            members = np.where(self.labels == j)[0]
+            members = np.where(labels == j)[0]
             self.members.append(members)
             if members.size == 0:
                 self.clusters.append(None)
@@ -123,9 +134,46 @@ class ClusteredLearner:
             )
             learner = FederatedLearner(cfg, dataset=ds, partitions=parts)
             learner.server_state = learner.server_state._replace(
-                params=base.server_state.params
+                params=init_params[j]
             )
             self.clusters.append(learner)
+
+    def reassign(self) -> np.ndarray:
+        """IFCA step (Ghosh et al. 2006.04088, pattern only): every client
+        picks the cluster whose CURRENT model has the lowest loss on its
+        own shard — K vmapped per-client eval programs over the base
+        learner's stacked shards, then an argmin on host."""
+        base = self.base
+        if not hasattr(base, "_client_eval_fn"):
+            base._client_eval_fn = base._build_client_eval_fn()
+        losses = []
+        for learner in self.clusters:
+            if learner is None:
+                losses.append(np.full(base.num_clients, np.inf))
+                continue
+            l, _ = base._client_eval_fn(
+                learner.server_state.params, *base._device_data[:3]
+            )
+            losses.append(np.asarray(l))
+        return np.argmin(np.stack(losses), axis=0).astype(np.int32)
+
+    def refine(self, iters: int = 2, rounds_per_iter: int = 2) -> np.ndarray:
+        """Alternate cluster training with IFCA reassignment.  Clients that
+        move adopt the model of their new cluster; clusters keep their
+        trained models across reassignments."""
+        if self.labels is None:
+            raise RuntimeError("call cluster_and_specialize() first")
+        for _ in range(iters):
+            self.fit(rounds=rounds_per_iter)
+            new = self.reassign()
+            if (new == self.labels).all():
+                break
+            params = [
+                (c.server_state.params if c is not None
+                 else self.base.server_state.params)
+                for c in self.clusters
+            ]
+            self._build_clusters(new, params)
         return self.labels
 
     def fit(self, rounds: int) -> None:

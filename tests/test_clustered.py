@@ -68,3 +68,24 @@ def test_clustering_recovers_concepts_and_beats_global():
     assert rep["weighted_acc"] > 0.9, rep
     assert rep["weighted_acc"] > srep["weighted_acc"] + 0.1, (
         rep["weighted_acc"], srep["weighted_acc"])
+
+
+def test_ifca_refinement_recovers_from_bad_clustering():
+    # Adversarial start: the initial labels deliberately mix the concepts
+    # (2 clients swapped across clusters).  IFCA reassignment must move
+    # them to the cluster whose model fits their shard.
+    clustered = ClusteredLearner(_concept_shift_learner(), num_clusters=2)
+    clustered.cluster_and_specialize(warmup_rounds=2)
+    true = np.array(clustered.labels)
+    bad = true.copy()
+    bad[0], bad[4] = true[4], true[0]           # swap one client each way
+    clustered._build_clusters(
+        bad, [c.server_state.params for c in clustered.clusters])
+    assert (np.array(clustered.labels) != true).sum() == 2
+
+    labels = clustered.refine(iters=3, rounds_per_iter=2)
+    assert (np.array(labels) == true).all(), (labels, true)
+
+    clustered.fit(rounds=4)
+    rep = clustered.evaluate_per_client()
+    assert rep["weighted_acc"] > 0.9, rep
