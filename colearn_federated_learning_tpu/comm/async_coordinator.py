@@ -265,9 +265,15 @@ class AsyncFederatedCoordinator:
                 self.server_state = strategies.server_update(
                     self.server_state, mean_delta, self.config.fed
                 )
-            self.version += 1
-        with self._version_cv:
-            self._version_cv.notify_all()     # wake pumps for the new version
+            # The version bump happens under BOTH locks: _state_lock keeps
+            # (server_state, version) consistent for _snapshot, and holding
+            # _version_cv across increment+notify closes the lost-wakeup
+            # window a pump would otherwise hit between reading version and
+            # calling wait() (today's 0.1 s poll would mask it, but the
+            # poll must not be load-bearing).
+            with self._version_cv:
+                self.version += 1
+                self._version_cv.notify_all()
         rec = {
             "aggregation": len(self.history),
             "model_version": self.version,

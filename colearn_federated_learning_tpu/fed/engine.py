@@ -113,18 +113,21 @@ class FederatedLearner:
                 "mesh= explicitly"
             )
         mesh = None
-        if r.tp_size > 1 and len(devices) < r.tp_size:
+        if r.tp_size > 1 and len(devices) % r.tp_size != 0:
+            # Non-divisible device counts would otherwise surface as an
+            # opaque reshape error inside make_mesh((-1, tp_size)).
             import warnings
 
             warnings.warn(
-                f"tp_size={r.tp_size} needs at least that many devices, "
-                f"have {len(devices)}; running without tensor parallelism",
+                f"tp_size={r.tp_size} needs a device count that is a "
+                f"multiple of it, have {len(devices)}; running without "
+                f"tensor parallelism",
                 stacklevel=2,
             )
         if len(devices) > 1:
             if config.model.attn_impl in ("ring", "ulysses"):
                 mesh = make_mesh((r.mesh_axis, r.seq_axis), devices=devices)
-            elif r.tp_size > 1 and len(devices) >= r.tp_size:
+            elif r.tp_size > 1 and len(devices) % r.tp_size == 0:
                 mesh = make_mesh((r.mesh_axis, r.tp_axis), (-1, r.tp_size),
                                  devices=devices)
             else:

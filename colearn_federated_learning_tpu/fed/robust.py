@@ -42,6 +42,12 @@ def _trimmed_leaf(xs: jax.Array, n_valid: jax.Array,
     The epsilon guards float32 products that are exactly integral in
     exact arithmetic (e.g. 0.45 · 20) from rounding DOWN a trim."""
     k = jnp.floor(trim_fraction * n_valid + 1e-4).astype(jnp.int32)
+    # Runtime dropouts can shrink n_valid below 1/trim_fraction, which
+    # would silently degrade the "robust" statistic to a plain mean for
+    # that round.  Whenever the caller asked for ANY trimming and at
+    # least 3 contributors remain, trim at least one row per side.
+    if trim_fraction > 0.0:
+        k = jnp.where(n_valid >= 3, jnp.maximum(k, 1), k)
     idx = jnp.arange(xs.shape[0])
     sel = (idx >= k) & (idx < n_valid - k)
     selb = sel.reshape((-1,) + (1,) * (xs.ndim - 1))
@@ -85,6 +91,13 @@ def _krum(stacked, maskb, n_valid, byz_fraction: float):
     d2 = jnp.maximum(d2, 0.0)                           # gram round-off
 
     f = jnp.floor(byz_fraction * n_valid + 1e-4).astype(jnp.int32)
+    # Same straggler hazard as the trimmed mean: a shrunken runtime
+    # n_valid must not round the assumed Byzantine count down to 0 (that
+    # would select ALL n_valid rows — plain mean).  Assume at least one
+    # attacker whenever the caller configured a nonzero fraction and
+    # enough contributors remain to exclude one.
+    if byz_fraction > 0.0:
+        f = jnp.where(n_valid >= 3, jnp.maximum(f, 1), f)
     k_nb = jnp.maximum(n_valid - f - 2, 1)              # neighbors scored
     d2s = jnp.sort(d2, axis=1)                          # inf sorts last
     nb_mask = (jnp.arange(n)[None, :] < k_nb).astype(jnp.float32)

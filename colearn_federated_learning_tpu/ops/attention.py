@@ -35,15 +35,47 @@ from jax.experimental import pallas as pl
 
 _NEG = -1e30
 
+@functools.lru_cache(maxsize=None)
+def _tpu_generation() -> int:
+    """TPU generation of the default backend's first device (0 = unknown
+    or not a TPU).  Drives the VMEM cap and block-size defaults: v4/v5/v6
+    carry ≥128 MB physical VMEM, v2/v3 far less."""
+    import re
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return 0
+    m = re.search(r"v(\d+)", kind.lower())
+    return int(m.group(1)) if m else 0
+
+
+def _default_block() -> int:
+    """512 on v4+ (and in interpret mode, where it only shortens the Python
+    loop); 128 on v2/v3 — or any TPU whose generation we cannot parse —
+    because the 512 configuration needs the raised VMEM cap that
+    ``_tpu_params`` only grants to known v4+ hardware."""
+    gen = _tpu_generation()
+    if gen >= 4:
+        return 512
+    if gen == 0 and jax.default_backend() != "tpu":
+        return 512
+    return 128
+
+
 def _tpu_params():
     """Mosaic compiler params for the non-interpret (real TPU) path: the
     default 16 MB scoped-vmem cap rejects the fast 512-block configuration
-    beyond L≈4k; the v5e has 128 MB physical VMEM, so raise the cap and
+    beyond L≈4k; v4/v5/v6 have ≥128 MB physical VMEM, so raise the cap and
     let the (bq, bk) f32 score tiles + whole-row K/V residency fit
-    (measured: L=32k fwd+bwd needs ~100 MB of scoped buffers)."""
+    (measured on v5e: L=32k fwd+bwd needs ~100 MB of scoped buffers).  On
+    older generations (v2/v3) the raised cap itself would fail Mosaic
+    compilation — keep the conservative 16 MB default there."""
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(vmem_limit_bytes=112 * 1024 * 1024)
+    if _tpu_generation() >= 4:
+        return pltpu.CompilerParams(vmem_limit_bytes=112 * 1024 * 1024)
+    return None
 
 
 def _round_up(n: int, m: int) -> int:
@@ -120,6 +152,10 @@ def _blocks(q, k, v, kv_mask, block_q, block_k, interpret):
     Lk = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None:
+        block_q = _default_block()
+    if block_k is None:
+        block_k = _default_block()
 
     bq = min(block_q, _round_up(Lq, 8))
     bk = min(block_k, _round_up(Lk, 8))
@@ -375,8 +411,8 @@ def flash_attention(
     kv_mask: Optional[jax.Array] = None,
     *,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Blockwise (flash) attention over ``(B, L, H, D)`` tensors.
@@ -384,5 +420,7 @@ def flash_attention(
     ``kv_mask``: optional ``(B, L_k)`` bool, False = padding key.  Fully
     masked query rows return 0, matching ``dense_attention``.
     ``interpret=None`` auto-selects Pallas interpret mode off-TPU.
+    ``block_q``/``block_k`` default per TPU generation (512 on v4+, 128 on
+    v2/v3 whose smaller VMEM rejects the large configuration).
     """
     return _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret)

@@ -187,6 +187,40 @@ def test_robust_guards():
 
 
 
+def test_trim_clamps_under_runtime_dropouts():
+    # Construction-time validation only sees the STATIC cohort size; at
+    # runtime stragglers can shrink n_valid so floor(trim * n_valid) hits
+    # 0 — e.g. trim 0.2 with 4 survivors.  The statistic must still trim
+    # one row per side rather than silently degrade to a plain mean.
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 5)).astype(np.float32)
+    x[1] = 1e4                        # outlier a real trim removes
+    mask = np.zeros(8, bool); mask[:4] = True
+    out = robust_aggregate({"w": jnp.asarray(x)}, jnp.asarray(mask),
+                           "trimmed_mean", trim_fraction=0.2)
+    got = np.asarray(out["w"])
+    ref = np.sort(x[:4], axis=0)[1:3].mean(axis=0)    # k clamped to 1
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+    # trim_fraction == 0 is an explicit "no trimming" request: no clamp.
+    out0 = robust_aggregate({"w": jnp.asarray(x)}, jnp.asarray(mask),
+                            "trimmed_mean", trim_fraction=0.0)
+    np.testing.assert_allclose(np.asarray(out0["w"]), x[:4].mean(axis=0),
+                               rtol=1e-5)
+
+
+def test_krum_clamps_f_under_runtime_dropouts():
+    # Same hazard for Krum: floor(0.2 * 4) = 0 would select ALL survivors
+    # (plain mean, attacker included); the clamp assumes >= 1 attacker.
+    rng = np.random.default_rng(7)
+    x = (1.0 + 0.01 * rng.normal(size=(8, 6))).astype(np.float32)
+    x[2] = 100.0                      # attacker among the 4 survivors
+    mask = np.zeros(8, bool); mask[:4] = True
+    out = robust_aggregate({"w": jnp.asarray(x)}, jnp.asarray(mask),
+                           "krum", trim_fraction=0.2)
+    got = np.asarray(out["w"])
+    np.testing.assert_allclose(got.mean(), 1.0, atol=0.05)
+
+
 def test_krum_survives_nan_rows():
     # A masked row (dropped straggler) full of NaN must not poison the
     # selection matmul (0 * NaN = NaN without sanitization).
