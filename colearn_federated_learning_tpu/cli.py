@@ -336,9 +336,13 @@ def cmd_bench(args: argparse.Namespace) -> int:
     from colearn_federated_learning_tpu import bench
 
     argv = ["--rounds", str(args.rounds), "--warmup", str(args.warmup),
-            "--baseline-rounds", str(args.baseline_rounds)]
+            "--baseline-rounds", str(args.baseline_rounds),
+            "--probe-timeout", str(args.probe_timeout),
+            "--probe-budget", str(args.probe_budget)]
     if args.skip_baseline:
         argv.append("--skip-baseline")
+    if args.force_cpu:
+        argv.append("--force-cpu")
     bench.main(argv)
     return 0
 
@@ -428,6 +432,9 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--warmup", type=int, default=2)
     p_bench.add_argument("--baseline-rounds", type=int, default=1)
     p_bench.add_argument("--skip-baseline", action="store_true")
+    p_bench.add_argument("--probe-timeout", type=float, default=90.0)
+    p_bench.add_argument("--probe-budget", type=float, default=210.0)
+    p_bench.add_argument("--force-cpu", action="store_true")
     p_bench.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)
