@@ -186,7 +186,8 @@ def cmd_train(args: argparse.Namespace) -> int:
             print(json.dumps({"name": config.run.name,
                               "rounds": len(learner.history),
                               "edge_groups": config.fed.edge_groups,
-                              "final_loss": loss, "final_acc": acc}))
+                              "final_loss": loss, "final_acc": acc,
+                              "data_source": learner.dataset.source}))
         return 0
 
     learner = FederatedLearner.from_config(config)
@@ -216,8 +217,11 @@ def cmd_train(args: argparse.Namespace) -> int:
         samples = (learner.cohort_size * learner.num_steps
                    * config.fed.batch_size)
         n_chips = learner.mesh.devices.size if learner.mesh is not None else 1
-        print(json.dumps(logger.summary(samples_per_round=samples,
-                                        n_chips=n_chips)))
+        summary = logger.summary(samples_per_round=samples, n_chips=n_chips)
+        # Which registry branch fed the run — so a user who staged real
+        # data under $COLEARN_DATA_DIR can confirm it was actually used.
+        summary["data_source"] = learner.dataset.source
+        print(json.dumps(summary))
     return 0
 
 

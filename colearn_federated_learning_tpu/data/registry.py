@@ -64,14 +64,52 @@ class Dataset:
 
 
 def _load_disk(spec: DatasetSpec) -> Dataset | None:
+    """Load ``$COLEARN_DATA_DIR/<name>.npz`` (keras-style arrays written by
+    ``scripts/fetch_data.py``).  A present-but-malformed file raises — a
+    user who staged real data must never silently train on synthetic."""
     root = os.environ.get("COLEARN_DATA_DIR", "")
     if not root:
         return None
     path = os.path.join(root, f"{spec.name}.npz")
     if not os.path.exists(path):
         return None
-    z = np.load(path)
-    return Dataset(spec, z["x_train"], z["y_train"], z["x_test"], z["y_test"], "disk")
+    arrays = {}
+    with np.load(path) as z:
+        missing = [k for k in ("x_train", "y_train", "x_test", "y_test")
+                   if k not in z]
+        if missing:
+            raise ValueError(f"{path} is missing arrays {missing} "
+                             "(expected the keras-style x/y train/test "
+                             "layout)")
+        for split in ("train", "test"):
+            x, y = z[f"x_{split}"], z[f"y_{split}"]
+            want = spec.input_shape
+            # Accept trailing-singleton-channel omission for grayscale
+            # images ((N, 28, 28) on disk vs spec (28, 28, 1)).
+            if (spec.kind == "image" and x.ndim == len(want)
+                    and want[-1] == 1 and x.shape[1:] == want[:-1]):
+                x = x[..., None]
+            if x.shape[1:] != want:
+                raise ValueError(
+                    f"{path}: x_{split} per-example shape {x.shape[1:]} "
+                    f"does not match the {spec.name} spec {want}")
+            if len(x) != len(y):
+                raise ValueError(
+                    f"{path}: x_{split}/y_{split} row counts differ "
+                    f"({len(x)} vs {len(y)})")
+            if spec.kind == "image" and x.dtype == np.uint8:
+                x = x.astype(np.float32) / 255.0   # keras raw-byte layout
+            y = y.reshape(-1)
+            # Range-check BEFORE the int32 cast: a corrupt wide integer
+            # must not wrap into the valid range and pass.
+            if y.size and (int(y.min()) < 0
+                           or int(y.max()) >= spec.num_classes):
+                raise ValueError(
+                    f"{path}: y_{split} labels outside "
+                    f"[0, {spec.num_classes})")
+            arrays[f"x_{split}"], arrays[f"y_{split}"] = x, y.astype(np.int32)
+    return Dataset(spec, arrays["x_train"], arrays["y_train"],
+                   arrays["x_test"], arrays["y_test"], "disk")
 
 
 def _make_synthetic(spec: DatasetSpec, seed: int) -> Dataset:

@@ -69,6 +69,7 @@ class HierarchicalLearner:
             )
         base = data_registry.get_dataset(config.data.dataset,
                                          seed=config.run.seed)
+        self.dataset = base        # registry branch visibility (disk/synth)
         n = len(base.y_train)
         clients_per_group = config.data.num_clients // num_groups
         self.groups: list[FederatedLearner] = []

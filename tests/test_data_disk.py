@@ -1,0 +1,123 @@
+"""Real-dataset disk path (data/registry.py `_load_disk`).
+
+All committed accuracy curves run on synthetic stand-ins because the
+sandbox has no network; these tests prove the DISK branch — the one a
+user with real data actually hits — works end to end: registry
+resolution order, keras-layout normalization, shape validation, and a
+full engine round training on disk-staged data.
+"""
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.data import registry
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def _write_npz(path, x_train, y_train, x_test, y_test):
+    np.savez(path, x_train=x_train, y_train=y_train,
+             x_test=x_test, y_test=y_test)
+
+
+def _stage_mnist_tiny(tmp_path, n_train=256, n_test=64, dtype=np.float32):
+    """A separable two-class-per-pixel-block dataset in the mnist_tiny
+    shape, written keras-style; labels 0..9."""
+    rng = np.random.default_rng(0)
+    y_tr = rng.integers(0, 10, n_train)
+    y_te = rng.integers(0, 10, n_test)
+
+    def make_x(y):
+        x = 0.1 * rng.standard_normal((len(y), 28, 28, 1))
+        for i, yi in enumerate(y):        # class-dependent bright block
+            x[i, 2 * yi: 2 * yi + 3, :5, 0] += 2.0
+        return x.astype(np.float32)
+
+    x_tr, x_te = make_x(y_tr), make_x(y_te)
+    if dtype == np.uint8:
+        x_tr = (np.clip(x_tr, 0, 1) * 255).astype(np.uint8)
+        x_te = (np.clip(x_te, 0, 1) * 255).astype(np.uint8)
+    _write_npz(tmp_path / "mnist_tiny.npz", x_tr, y_tr, x_te, y_te)
+    return x_tr, y_tr
+
+
+def test_registry_prefers_disk(tmp_path, monkeypatch):
+    x_tr, y_tr = _stage_mnist_tiny(tmp_path)
+    monkeypatch.setenv("COLEARN_DATA_DIR", str(tmp_path))
+    ds = registry.get_dataset("mnist_tiny", seed=0)
+    assert ds.source == "disk"
+    np.testing.assert_array_equal(ds.y_train, y_tr.astype(np.int32))
+    np.testing.assert_allclose(ds.x_train, x_tr, atol=1e-6)
+    # Other names still fall back to synthetic.
+    assert registry.get_dataset("cifar10_tiny").source == "synthetic"
+    # Without the env var the same name is synthetic again.
+    monkeypatch.delenv("COLEARN_DATA_DIR")
+    assert registry.get_dataset("mnist_tiny").source == "synthetic"
+
+
+def test_disk_normalizes_keras_raw_bytes(tmp_path, monkeypatch):
+    # uint8 0..255 images (the layout keras/fetch scripts produce) must be
+    # scaled to [0, 1] float32; (N, 28, 28) grayscale gets its channel dim.
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 256, (32, 28, 28), dtype=np.uint8)
+    y = rng.integers(0, 10, 32)
+    _write_npz(tmp_path / "mnist_tiny.npz", x, y, x[:8], y[:8])
+    monkeypatch.setenv("COLEARN_DATA_DIR", str(tmp_path))
+    ds = registry.get_dataset("mnist_tiny")
+    assert ds.source == "disk"
+    assert ds.x_train.dtype == np.float32
+    assert ds.x_train.shape == (32, 28, 28, 1)
+    assert 0.0 <= ds.x_train.min() and ds.x_train.max() <= 1.0
+
+
+@pytest.mark.parametrize("corruption",
+                         ["missing_key", "bad_shape", "bad_labels",
+                          "wrapping_labels"])
+def test_disk_malformed_raises(tmp_path, monkeypatch, corruption):
+    # A staged-but-broken file must raise loudly, never silently fall back
+    # to synthetic (the user believes they are training on real data).
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((16, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 16)
+    if corruption == "missing_key":
+        np.savez(tmp_path / "mnist_tiny.npz", x_train=x, y_train=y, x_test=x)
+    elif corruption == "bad_shape":
+        _write_npz(tmp_path / "mnist_tiny.npz", x[:, :14], y, x, y)
+    elif corruption == "wrapping_labels":
+        # int64 values that would WRAP into range under an int32 cast;
+        # the range check must run on the original width.
+        yw = y.astype(np.int64)
+        yw[0] = 2**32 + 3
+        _write_npz(tmp_path / "mnist_tiny.npz", x, yw, x, y)
+    else:
+        _write_npz(tmp_path / "mnist_tiny.npz", x, y + 100, x, y)
+    monkeypatch.setenv("COLEARN_DATA_DIR", str(tmp_path))
+    with pytest.raises(ValueError, match="mnist_tiny.npz"):
+        registry.get_dataset("mnist_tiny")
+
+
+def test_engine_trains_on_disk_data(tmp_path, monkeypatch):
+    # End to end: registry -> partitioner -> engine round on disk-staged
+    # data.  The staged dataset is separable, so accuracy must climb well
+    # above chance within a few rounds.
+    _stage_mnist_tiny(tmp_path, n_train=512, n_test=128)
+    monkeypatch.setenv("COLEARN_DATA_DIR", str(tmp_path))
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=8, partition="iid",
+                        max_examples_per_client=64),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=6, cohort_size=0,
+                      local_steps=4, batch_size=16, lr=0.1, momentum=0.9),
+        run=RunConfig(name="disk_e2e"),
+    )
+    learner = FederatedLearner(cfg)
+    assert learner.dataset.source == "disk"
+    learner.fit(rounds=6)
+    _, acc = learner.evaluate()
+    assert acc > 0.5, acc
