@@ -62,14 +62,16 @@ def kmeans_rows(X: np.ndarray, k: int, iters: int = 50,
 class ClusteredLearner:
     """Warm up → cluster by update similarity → one learner per cluster.
 
-    Built ON an existing single-device ``FederatedLearner`` (its packed
-    shards are the ground truth of who owns which examples, so tests can
-    manipulate per-client data before clustering).
+    Built ON an existing ``FederatedLearner`` (its packed shards are the
+    ground truth of who owns which examples, so tests can manipulate
+    per-client data before clustering).  Works on both engine paths: on a
+    mesh the similarity matrix is computed under shard_map (all_gather of
+    the normalized deltas over the client axis), labels/members are kept
+    in ORIGINAL client-id order, and each cluster learner trains over the
+    same mesh.
     """
 
     def __init__(self, base: FederatedLearner, num_clusters: int = 2):
-        if base.mesh is not None:
-            raise NotImplementedError("cluster on the vmap path")
         if num_clusters < 2:
             raise ValueError(f"num_clusters must be >= 2, got {num_clusters}")
         self.base = base
@@ -77,6 +79,17 @@ class ClusteredLearner:
         self.labels: Optional[np.ndarray] = None
         self.clusters: list[FederatedLearner] = []
         self.members: list[np.ndarray] = []
+
+    def _label_slots(self) -> np.ndarray:
+        """Array-slot index of each LABELED client, in label order.
+
+        The similarity matrix (and therefore ``labels``) is in ORIGINAL
+        client-id order with mesh ghost padding dropped; the base
+        learner's stacked arrays are in slot order (interleaved on a
+        mesh).  ``_label_slots()[i]`` is the slot holding labeled client
+        ``i``'s shard — the engine's own id-order mapping, so the filter
+        can never diverge from ``client_update_similarity``'s."""
+        return self.base.id_order_slots()
 
     def cluster_and_specialize(self, warmup_rounds: int = 2,
                                sim_steps: int = 3) -> np.ndarray:
@@ -109,15 +122,17 @@ class ClusteredLearner:
         x = np.asarray(base._device_data[0])
         y = np.asarray(base._device_data[1])   # tests may have edited y
         counts = np.asarray(base.shards.counts)
+        slots = self._label_slots()
         for j in range(self.num_clusters):
             members = np.where(labels == j)[0]
             self.members.append(members)
             if members.size == 0:
                 self.clusters.append(None)
                 continue
-            xs = np.concatenate([x[i][: counts[i]] for i in members])
-            ys = np.concatenate([y[i][: counts[i]] for i in members])
-            offsets = np.cumsum([0] + [int(counts[i]) for i in members])
+            m_slots = slots[members]
+            xs = np.concatenate([x[i][: counts[i]] for i in m_slots])
+            ys = np.concatenate([y[i][: counts[i]] for i in m_slots])
+            offsets = np.cumsum([0] + [int(counts[i]) for i in m_slots])
             parts = [np.arange(offsets[m], offsets[m + 1])
                      for m in range(members.size)]
             ds = dataclasses.replace(
@@ -132,7 +147,11 @@ class ClusteredLearner:
                     name=f"{base.config.run.name}_cluster{j}",
                 ),
             )
-            learner = FederatedLearner(cfg, dataset=ds, partitions=parts)
+            # Cluster learners inherit the base's mesh: on a pod each
+            # cluster trains sharded over the same client axis (small
+            # clusters pad with ghosts, which never contribute).
+            learner = FederatedLearner(cfg, dataset=ds, mesh=base.mesh,
+                                       partitions=parts)
             learner.server_state = learner.server_state._replace(
                 params=init_params[j]
             )
@@ -146,15 +165,17 @@ class ClusteredLearner:
         base = self.base
         if not hasattr(base, "_client_eval_fn"):
             base._client_eval_fn = base._build_client_eval_fn()
+        slots = self._label_slots()
         losses = []
         for learner in self.clusters:
             if learner is None:
-                losses.append(np.full(base.num_clients, np.inf))
+                losses.append(np.full(slots.size, np.inf))
                 continue
             l, _ = base._client_eval_fn(
                 learner.server_state.params, *base._device_data[:3]
             )
-            losses.append(np.asarray(l))
+            # Slot order -> label (original-id) order, ghosts dropped.
+            losses.append(np.asarray(l)[slots])
         return np.argmin(np.stack(losses), axis=0).astype(np.int32)
 
     def refine(self, iters: int = 2, rounds_per_iter: int = 2) -> np.ndarray:

@@ -224,6 +224,7 @@ class FederatedLearner:
                     f"{self.seq_size}-way {self.seq_axis!r} axis; use "
                     "attn_impl='ring'"
                 )
+        self.real_num_clients = shards.num_clients   # pre-ghost-padding
         if mesh is not None:
             shards = pad_clients_to_multiple(shards, self.clients_size)
             # Interleave so real clients spread evenly across devices (ghost
@@ -1048,15 +1049,16 @@ class FederatedLearner:
         aligned updates, concept-shifted clients anti-align.
 
         One jit program: vmapped local steps over ALL clients, flatten,
-        one gram matmul (MXU) — the (N, P) matrix never leaves the device;
-        only the (N, N) similarity does.  vmap path only (cross-device
-        gram over a mesh would move every delta anyway).
+        one gram matmul (MXU).  On the vmap path the (N, P) matrix never
+        leaves the device.  On a mesh each device trains only ITS client
+        block, L2-normalizes the (N/D, P) rows, all_gathers the
+        normalized deltas over the client axis (robust aggregation pays
+        the same O(N·P) price — order statistics and gram matrices are
+        not psum-decomposable), computes its (N/D, N) strip of the gram
+        on the MXU, and the strips reassemble to the sharded (N, N)
+        output; rows/cols are then returned to ORIGINAL client-id order
+        with ghost padding dropped.
         """
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "client_update_similarity runs on the single-device vmap "
-                "path; build the learner without a mesh for clustering"
-            )
         if self.scaffold:
             raise NotImplementedError(
                 "clustering uses the plain local trainer; run it with a "
@@ -1066,28 +1068,76 @@ class FederatedLearner:
             self._sim_key = steps
             budget = jnp.asarray(min(steps, self.num_steps), jnp.int32)
 
-            def sim(params, x, y, counts, ids, key):
+            def flat_norm_deltas(params, x, y, counts, ids, key, n_rows):
                 keys = jax.vmap(
                     lambda i: prng.client_round_key(key, i, 1 << 23)
                 )(ids)
-                budgets = jnp.full((self.num_clients,), budget, jnp.int32)
+                budgets = jnp.full((n_rows,), budget, jnp.int32)
                 res = jax.vmap(self.local_update,
                                in_axes=(None, 0, 0, 0, 0, 0))(
                     params, x, y, counts, keys, budgets
                 )
                 X = jnp.concatenate(
-                    [l.reshape(self.num_clients, -1).astype(jnp.float32)
+                    [l.reshape(n_rows, -1).astype(jnp.float32)
                      for l in jax.tree.leaves(res.delta)], axis=1,
                 )
-                Xn = X / jnp.maximum(
+                return X / jnp.maximum(
                     jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12
                 )
-                return Xn @ Xn.T
 
-            self._sim_fn = jax.jit(sim)
-        return np.asarray(self._sim_fn(
+            if self.mesh is None:
+                def sim(params, x, y, counts, ids, key):
+                    Xn = flat_norm_deltas(params, x, y, counts, ids, key,
+                                          self.num_clients)
+                    return Xn @ Xn.T
+
+                self._sim_fn = jax.jit(sim)
+            else:
+                ax = self.client_axis
+                local_clients = self.num_clients // self.clients_size
+
+                def sim_body(params, x_blk, y_blk, counts_blk, ids_blk,
+                             key):
+                    Xn = flat_norm_deltas(params, x_blk, y_blk, counts_blk,
+                                          ids_blk, key, local_clients)
+                    x_all = jax.lax.all_gather(Xn, ax)
+                    x_all = x_all.reshape(-1, Xn.shape[1])     # (N, P)
+                    return Xn @ x_all.T                        # (N/D, N)
+
+                x_spec = (P(ax, None, self.seq_axis) if self.sp
+                          else P(ax))
+                self._sim_fn = jax.jit(shard_map(
+                    sim_body,
+                    mesh=self.mesh,
+                    in_specs=(P(), x_spec, P(ax), P(ax), P(ax), P()),
+                    out_specs=P(ax, None),
+                    axis_names=self._manual_axes(),
+                    check_vma=False,
+                ))
+        sim = np.asarray(self._sim_fn(
             self.server_state.params, *self._device_data, self.base_key
         ))
+        if self.mesh is not None:
+            # Undo the mesh interleaving on BOTH axes; drop ghost padding.
+            keep = self.id_order_slots()
+            sim = sim[np.ix_(keep, keep)]
+        return sim
+
+    def id_order_slots(self) -> np.ndarray:
+        """Array-slot index of every REAL client, in original client-id
+        order — the inverse of the mesh interleaving with ghost padding
+        dropped; the identity on the vmap path.
+
+        Ghosts are identified by id (``id >= real_num_clients``: padding
+        appends them after the real clients), NOT by ``counts == 0`` — a
+        real client whose partition happens to be empty must keep its
+        slot so per-id indexing (clustered FL labels) stays aligned
+        across engine paths."""
+        if self.mesh is None:
+            return np.arange(self.num_clients)
+        ids = np.asarray(self.client_ids)
+        order = np.argsort(ids, kind="stable")
+        return order[:self.real_num_clients]
 
     # ---- personalized evaluation (fine-tune-then-eval) ----------------
     def evaluate_personalized(self, steps: int = 5,

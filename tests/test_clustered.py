@@ -70,6 +70,86 @@ def test_clustering_recovers_concepts_and_beats_global():
         rep["weighted_acc"], srep["weighted_acc"])
 
 
+def test_similarity_mesh_matches_vmap(cpu_devices):
+    # The shard_map similarity (all_gather of normalized deltas over the
+    # client axis) must reproduce the single-device gram matrix: local
+    # updates are keyed on ORIGINAL client ids, so placement cannot change
+    # the deltas, and the mesh output is re-ordered to id order.
+    from jax.sharding import Mesh
+
+    ref = FederatedLearner(_cfg())
+    sim_ref = ref.client_update_similarity(steps=2)
+
+    mesh = Mesh(np.array(cpu_devices[:4]), ("clients",))
+    m = FederatedLearner(_cfg(), mesh=mesh)
+    sim_mesh = m.client_update_similarity(steps=2)
+
+    assert sim_mesh.shape == sim_ref.shape == (8, 8)
+    np.testing.assert_allclose(sim_mesh, sim_ref, atol=1e-5)
+
+
+def test_similarity_mesh_drops_ghost_padding(cpu_devices):
+    # 6 clients on a 4-device mesh pad to 8 slots; the similarity matrix
+    # must come back (6, 6) in original client-id order.
+    import dataclasses
+
+    from jax.sharding import Mesh
+
+    cfg = _cfg()
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, num_clients=6))
+    ref = FederatedLearner(cfg)
+    sim_ref = ref.client_update_similarity(steps=2)
+
+    mesh = Mesh(np.array(cpu_devices[:4]), ("clients",))
+    m = FederatedLearner(cfg, mesh=mesh)
+    sim_mesh = m.client_update_similarity(steps=2)
+
+    assert sim_mesh.shape == sim_ref.shape == (6, 6)
+    np.testing.assert_allclose(sim_mesh, sim_ref, atol=1e-5)
+
+
+def test_empty_real_client_rejected_at_packing():
+    # The id-based ghost filter in id_order_slots assumes every REAL
+    # client owns >= 1 example; the data layer enforces exactly that, so
+    # counts==0 can only ever mean ghost padding.  Pin the guard.
+    import pytest
+
+    parts = [list(range(i * 40, (i + 1) * 40)) for i in range(5)] + [[]]
+    import dataclasses
+
+    cfg = _cfg()
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, num_clients=6))
+    with pytest.raises(ValueError, match="zero examples"):
+        FederatedLearner(cfg, partitions=parts)
+
+
+def test_clustered_fl_on_mesh(cpu_devices):
+    # Full clustered pipeline over a client mesh: concept recovery from
+    # the shard_map similarity, per-cluster training on the same mesh,
+    # per-client accuracy at the specialized level.
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(cpu_devices[:8]), ("clients",))
+    base = FederatedLearner(_cfg(), mesh=mesh)
+    x, y, counts, ids = base._device_data
+    yh = np.array(y)
+    shifted = np.isin(np.asarray(base.client_ids), np.arange(4, 8))
+    yh[shifted] = (9 - yh[shifted]) % 10
+    base._device_data = (x, jnp.asarray(yh), counts, ids)
+
+    clustered = ClusteredLearner(base, num_clusters=2)
+    labels = clustered.cluster_and_specialize(warmup_rounds=2)
+    assert len(set(labels[:4])) == 1 and len(set(labels[4:])) == 1
+    assert labels[0] != labels[4]
+    for learner in clustered.clusters:
+        assert learner.mesh is mesh
+
+    clustered.fit(rounds=6)
+    rep = clustered.evaluate_per_client()
+    assert sorted(rep["cluster_sizes"]) == [4, 4]
+    assert rep["weighted_acc"] > 0.9, rep
+
+
 def test_ifca_refinement_recovers_from_bad_clustering():
     # Adversarial start: the initial labels deliberately mix the concepts
     # (2 clients swapped across clusters).  IFCA reassignment must move
