@@ -60,6 +60,7 @@ class MessageBroker:
 
     def stop(self) -> None:
         self._stopping.set()
+        protocol.wake_accept(self.host, self.port)
         try:
             self._srv.close()
         except OSError:
@@ -108,7 +109,8 @@ class MessageBroker:
                 header, body = protocol.recv_msg(conn)
                 op = header.get("op")
                 if op == "sub":
-                    self._subscribe(conn, header["topic"])
+                    self._subscribe(conn, header["topic"],
+                                    ack=bool(header.get("ack")))
                 elif op == "pub":
                     self._publish(header, body)
                 elif op == "ping":
@@ -135,15 +137,26 @@ class MessageBroker:
         except OSError:
             pass
 
-    def _subscribe(self, conn: socket.socket, pattern: str) -> None:
+    def _subscribe(self, conn: socket.socket, pattern: str,
+                   ack: bool = False) -> None:
+        """Register ``pattern`` (idempotent: re-subscribes replay retained
+        messages — MQTT semantics — without growing the subscription
+        list) and, when ``ack``, follow the replay with a ``suback``
+        frame so the client KNOWS the replay is complete — how
+        enrollment.fetch_device_info distinguishes the current retained
+        record from stale leftovers in its queue."""
         with self._lock:
-            self._subs.setdefault(conn, []).append(pattern)
+            pats = self._subs.setdefault(conn, [])
+            if pattern not in pats:
+                pats.append(pattern)
             replay = [
                 (dict(h), b) for t, (h, b) in self._retained.items()
                 if _match(pattern, t)
             ]
         for h, b in replay:
             self._send(conn, h, b)
+        if ack:
+            self._send(conn, {"op": "suback", "topic": pattern}, b"")
 
     def _publish(self, header: dict, body: bytes) -> None:
         topic = header["topic"]
@@ -186,9 +199,14 @@ class BrokerClient:
         except (protocol.ConnectionClosed, OSError, ValueError):
             self._q.put(None)                 # sentinel: connection is gone
 
-    def subscribe(self, topic: str) -> None:
+    def subscribe(self, topic: str, ack: bool = False) -> None:
+        """``ack=True`` asks the broker to append a ``suback`` frame after
+        the retained replay (see MessageBroker._subscribe)."""
+        header = {"op": "sub", "topic": topic}
+        if ack:
+            header["ack"] = True
         with self._wlock:
-            protocol.send_msg(self._sock, {"op": "sub", "topic": topic})
+            protocol.send_msg(self._sock, header)
 
     def publish(self, topic: str, fields: Optional[dict] = None,
                 body: bytes = b"", retain: bool = False) -> None:

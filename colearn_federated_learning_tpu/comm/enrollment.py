@@ -36,6 +36,10 @@ class DeviceInfo:
     port: int                         # tensor-plane server (transport.py)
     num_examples: int = 0
     dataset: str = ""
+    # Hex-encoded DH public key for wire-plane secure aggregation
+    # (comm/keyexchange.py); empty when the worker runs without masking
+    # or in shared_seed mode.
+    pubkey: str = ""
 
     def to_fields(self) -> dict:
         return dataclasses.asdict(self)
@@ -45,6 +49,60 @@ def announce(client: BrokerClient, info: DeviceInfo) -> None:
     """Device side: publish readiness (reference: publish on MQTT topic)."""
     client.publish(ENROLL_TOPIC + info.device_id, info.to_fields(),
                    retain=True)
+
+
+def _parse_enroll(header: dict) -> DeviceInfo:
+    return DeviceInfo(
+        device_id=str(header["device_id"]),
+        host=str(header["host"]),
+        port=int(header["port"]),
+        num_examples=int(header.get("num_examples", 0)),
+        dataset=str(header.get("dataset", "")),
+        pubkey=str(header.get("pubkey", "")),
+    )
+
+
+def fetch_device_info(client: BrokerClient, device_id: str,
+                      timeout: float = 10.0,
+                      cache: Optional[dict] = None) -> DeviceInfo:
+    """Read one device's CURRENT retained enrollment record — how a
+    worker looks up a PEER's DH public key for wire-plane secure
+    aggregation.
+
+    Subscribes with ``ack`` and reads until the broker's ``suback``
+    arrives: everything queued BEFORE it (stale leftovers from earlier
+    rounds, live re-announce pushes) is parsed but superseded by later
+    records, so the returned record is the one the broker retained at
+    subscribe time — a peer that re-enrolled with a fresh key can never
+    be read one-restart behind.  Every enrollment record seen is stored
+    into ``cache`` (a ``{device_id: DeviceInfo}`` dict the caller keeps
+    across calls), so records for other subscribed peers are never
+    consumed-and-lost.
+    """
+    if cache is not None and device_id in cache:
+        return cache[device_id]
+    topic = ENROLL_TOPIC + device_id
+    client.subscribe(topic, ack=True)
+    deadline = time.monotonic() + timeout
+    found = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"no enrollment record for {device_id!r}")
+        header, _ = client.recv(timeout=remaining)
+        if header.get("op") == "suback" and header.get("topic") == topic:
+            if found is not None:
+                return found
+            raise TimeoutError(
+                f"device {device_id!r} has no retained enrollment record"
+            )
+        if not str(header.get("topic", "")).startswith(ENROLL_TOPIC):
+            continue
+        info = _parse_enroll(header)
+        if cache is not None:
+            cache[info.device_id] = info
+        if info.device_id == device_id:
+            found = info             # keep reading: latest wins
 
 
 def await_role(client: BrokerClient, device_id: str,
@@ -89,15 +147,11 @@ class EnrollmentManager:
                 header, _ = self._client.recv(timeout=remaining)
             except (TimeoutError, OSError):
                 return
-            if not str(header.get("topic", "")).startswith(ENROLL_TOPIC):
+            if (header.get("op") == "suback"
+                    or not str(header.get("topic", "")).startswith(
+                        ENROLL_TOPIC)):
                 continue
-            info = DeviceInfo(
-                device_id=str(header["device_id"]),
-                host=str(header["host"]),
-                port=int(header["port"]),
-                num_examples=int(header.get("num_examples", 0)),
-                dataset=str(header.get("dataset", "")),
-            )
+            info = _parse_enroll(header)
             with self._lock:
                 if info.device_id not in self._devices:
                     self._order.append(info.device_id)

@@ -1,0 +1,98 @@
+"""Diffie-Hellman key agreement for wire-plane secure aggregation.
+
+Why this exists: the engine-plane masking (privacy/secure_agg.py) derives
+pair keys from the shared experiment seed — fine for a SIMULATION, where
+one process holds every client anyway, but on the socket deployment the
+coordinator also holds that seed and could expand any pair's mask and
+unmask any single client, which is precisely what Bonawitz-pattern secure
+aggregation exists to prevent (1611.04482, pattern only; PAPERS.md).
+
+Here every worker generates an ephemeral keypair, publishes the PUBLIC
+half on its retained enrollment topic (comm/enrollment.py), and derives
+each pairwise mask PRG seed from the DH shared secret — which only the
+two pair members can compute.  The coordinator sees public keys and
+masked updates only.
+
+Construction: finite-field DH over the RFC 3526 group-14 2048-bit MODP
+prime (stdlib-only: ``pow(g, x, p)`` + SHA-256), 512-bit exponents.  The
+prime is a safe prime, so the subgroup checks reduce to the range check
+in :func:`validate_public` (1 < pub < p-1 excludes the order-1/2
+elements).  Pair key: SHA-256(secret ‖ context-tag ‖ sorted pair ids) →
+64-bit PRNG seed; the round index is folded in on-device so one exchange
+covers every round.
+
+Remaining trust model (honest statement): this defeats a PASSIVE
+(honest-but-curious) coordinator.  An ACTIVE attacker who controls the
+broker could substitute its own public keys (classic DH MITM) — defeating
+that needs authenticated enrollment (device certificates), out of scope
+here and called out in the README.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+import jax
+import numpy as np
+
+# RFC 3526 §3, group 14: 2048-bit MODP prime, generator 2.
+GROUP14_P = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+GROUP14_G = 2
+
+_CONTEXT = b"colearn-pairmask-v1"
+
+
+def generate_keypair() -> tuple[int, int]:
+    """(private, public) for one worker session.  512-bit exponent —
+    comfortably above group 14's ~110-bit security level."""
+    priv = secrets.randbits(512) | (1 << 511)     # top bit set: full size
+    return priv, pow(GROUP14_G, priv, GROUP14_P)
+
+
+def validate_public(pub: int) -> int:
+    """Reject degenerate public values (0, 1, p-1 — the order-1/2
+    elements of the safe-prime group — and anything out of range)."""
+    if not 1 < pub < GROUP14_P - 1:
+        raise ValueError("invalid DH public key (out of range)")
+    return pub
+
+
+def shared_secret(priv: int, pub_other: int) -> bytes:
+    """32-byte shared secret for one pair (hashing fixes the length and
+    breaks the algebraic structure of the raw DH value)."""
+    validate_public(pub_other)
+    z = pow(pub_other, priv, GROUP14_P)
+    return hashlib.sha256(z.to_bytes(256, "big")).digest()
+
+
+def pair_prng_key(secret: bytes, id_a: int, id_b: int) -> jax.Array:
+    """uint32[2] PRNG key-data for one pair's mask stream.  Symmetric in
+    (id_a, id_b) — both members expand the identical stream, which is
+    what makes the masks cancel inside the aggregate sum.  The round
+    index is NOT baked in; callers fold it on-device
+    (privacy/secure_agg.pairwise_mask_with_keys)."""
+    lo, hi = sorted((int(id_a), int(id_b)))
+    digest = hashlib.sha256(
+        _CONTEXT + secret + lo.to_bytes(8, "big") + hi.to_bytes(8, "big")
+    ).digest()
+    words = np.frombuffer(digest[:8], dtype=">u4").astype(np.uint32)
+    return jax.numpy.asarray(words)
+
+
+def encode_public(pub: int) -> str:
+    return format(pub, "x")
+
+
+def decode_public(text: str) -> int:
+    return validate_public(int(text, 16))

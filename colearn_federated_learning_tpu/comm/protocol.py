@@ -59,3 +59,19 @@ def connect(host: str, port: int, timeout: Optional[float] = None) -> socket.soc
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return sock
+
+
+def wake_accept(host: str, port: int) -> None:
+    """Unblock a thread stuck in ``accept(2)`` on (host, port).
+
+    On Linux, closing a listening socket from another thread does NOT
+    interrupt an in-progress accept syscall (the kernel holds the file
+    reference until it returns), which would leave the LISTEN socket
+    alive and the port occupied.  A throwaway connection forces accept to
+    return; callers set their stop flag FIRST so the accept loop exits.
+    Shared by MessageBroker.stop and TensorServer.stop."""
+    try:
+        wake = socket.create_connection((host, port), timeout=1.0)
+        wake.close()
+    except OSError:
+        pass

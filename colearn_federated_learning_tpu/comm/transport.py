@@ -51,6 +51,9 @@ class TensorServer:
         must actually disappear from the federation, not linger on
         already-open sockets."""
         self._stopping.set()
+        # A worker restarting on its own port must be able to rebind:
+        # wake the blocked accept before closing (protocol.wake_accept).
+        protocol.wake_accept(self.host, self.port)
         try:
             self._srv.close()
         except OSError:

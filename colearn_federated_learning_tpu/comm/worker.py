@@ -68,6 +68,30 @@ class DeviceWorker:
                 "are dense gaussian-scale payloads, and lossy compression "
                 "would break the pairwise mask cancellation"
             )
+        if c.fed.secure_agg_key_exchange not in ("dh", "shared_seed"):
+            raise ValueError(
+                "secure_agg_key_exchange must be 'dh' or 'shared_seed', "
+                f"got {c.fed.secure_agg_key_exchange!r}"
+            )
+        self._dh_mode = (c.fed.secure_agg
+                         and c.fed.secure_agg_key_exchange == "dh")
+        if self._dh_mode:
+            if broker_host is None:
+                raise ValueError(
+                    "secure_agg with key_exchange='dh' needs the broker "
+                    "control plane to distribute public keys; pass "
+                    "secure_agg_key_exchange='shared_seed' ONLY if you "
+                    "trust the coordinator with every pair key"
+                )
+            from colearn_federated_learning_tpu.comm import keyexchange
+
+            self._dh_priv, self._dh_pub = keyexchange.generate_keypair()
+            self._dh_lock = threading.Lock()
+            self._dh_lookup = None        # dedicated broker connection
+            self._dh_stopped = False
+            self._peer_info_cache: dict = {}   # cleared each round
+            self._peer_keys: dict = {}    # id -> (pubkey_str, key uint32[2])
+            self._peer_round: Optional[int] = None
 
         ds = dataset or data_registry.get_dataset(c.data.dataset,
                                                   seed=c.run.seed)
@@ -120,11 +144,15 @@ class DeviceWorker:
             self._broker.subscribe(
                 enrollment.ROLE_TOPIC + str(self.client_id)
             )
+            from colearn_federated_learning_tpu.comm import keyexchange
+
             enrollment.announce(self._broker, enrollment.DeviceInfo(
                 device_id=str(self.client_id),
                 host=self.host, port=self.port,
                 num_examples=self.num_examples,
                 dataset=self.config.data.dataset,
+                pubkey=(keyexchange.encode_public(self._dh_pub)
+                        if self._dh_mode else ""),
             ))
         return self
 
@@ -140,6 +168,15 @@ class DeviceWorker:
         self._server.stop()
         if self._broker is not None:
             self._broker.close()
+        if getattr(self, "_dh_mode", False):
+            # Under the lock + a stopped flag: an in-flight train handler
+            # must not recreate the lookup connection after we close it
+            # (that would leak a socket + reader thread per restart).
+            with self._dh_lock:
+                self._dh_stopped = True
+                if self._dh_lookup is not None:
+                    self._dh_lookup.close()
+                    self._dh_lookup = None
 
     def __enter__(self):
         return self.start()
@@ -181,6 +218,62 @@ class DeviceWorker:
         )
         return table[0]
 
+    def _dh_pair_keys(self, partner_ids, round_idx: int) -> tuple[Any, Any]:
+        """(P, 2) uint32 pair-key rows + (P,) signs for ``partner_ids``,
+        derived from Diffie-Hellman shared secrets — each row computable
+        only by the two pair members, never by the coordinator.
+
+        Peer public keys come from their RETAINED enrollment records,
+        refetched once per ROUND (a restarted peer re-enrolls with a
+        fresh ephemeral key; masking against its stale key would break
+        pair cancellation and silently corrupt the aggregate).  The
+        2048-bit modexp per pair is recomputed only when a peer's public
+        key actually changed.  Runs on a DEDICATED broker connection —
+        sharing the enrollment client's single message queue would race
+        ``await_role`` and other concurrent train requests."""
+        from colearn_federated_learning_tpu.comm import keyexchange
+        from colearn_federated_learning_tpu.comm.broker import BrokerClient
+
+        with self._dh_lock:
+            if self._dh_stopped:
+                raise RuntimeError("worker is stopped")
+            if self._dh_lookup is None:
+                bh, bp = self._broker_addr
+                self._dh_lookup = BrokerClient(bh, bp)
+            if self._peer_round != round_idx:
+                self._peer_info_cache.clear()
+                self._peer_round = round_idx
+            keys, signs = [], []
+            for p in np.asarray(partner_ids).tolist():
+                p = int(p)
+                if p == self.client_id:
+                    keys.append(np.zeros(2, np.uint32))  # self-pair: sign 0
+                    signs.append(0.0)
+                    continue
+                info = enrollment.fetch_device_info(
+                    self._dh_lookup, str(p), cache=self._peer_info_cache
+                )
+                if not info.pubkey:
+                    raise RuntimeError(
+                        f"peer {p} enrolled without a DH public key; all "
+                        "cohort members must run secure_agg_key_exchange="
+                        "'dh'"
+                    )
+                cached = self._peer_keys.get(p)
+                if cached is None or cached[0] != info.pubkey:
+                    secret = keyexchange.shared_secret(
+                        self._dh_priv,
+                        keyexchange.decode_public(info.pubkey),
+                    )
+                    cached = (info.pubkey, np.asarray(
+                        keyexchange.pair_prng_key(secret, self.client_id, p)
+                    ))
+                    self._peer_keys[p] = cached
+                keys.append(cached[1])
+                signs.append(1.0 if p > self.client_id else -1.0)
+        return (jnp.asarray(np.stack(keys)),
+                jnp.asarray(np.asarray(signs, np.float32)))
+
     def _train(self, round_idx: int, global_params: Any,
                cohort=None) -> tuple[dict, Any]:
         params = jax.tree.map(jnp.asarray, global_params)
@@ -201,12 +294,20 @@ class DeviceWorker:
             # the engine's secure path.
             from colearn_federated_learning_tpu.privacy import secure_agg as sa
 
-            delta = sa.mask_update(
-                jax.tree.map(lambda l: l.astype(jnp.float32), delta),
-                self._key, jnp.asarray(self.client_id, jnp.int32),
-                self._partner_row(round_idx, cohort),
-                jnp.asarray(round_idx, jnp.int32),
-            )
+            delta_f32 = jax.tree.map(lambda l: l.astype(jnp.float32), delta)
+            partners = self._partner_row(round_idx, cohort)
+            if self._dh_mode:
+                pair_keys, signs = self._dh_pair_keys(partners, round_idx)
+                delta = sa.mask_update_with_keys(
+                    delta_f32, pair_keys, signs,
+                    jnp.asarray(round_idx, jnp.int32),
+                )
+            else:
+                delta = sa.mask_update(
+                    delta_f32, self._key,
+                    jnp.asarray(self.client_id, jnp.int32), partners,
+                    jnp.asarray(round_idx, jnp.int32),
+                )
             weight = 1.0
         meta = {"round": round_idx, "weight": weight,
                 "client_id": self.client_id,
@@ -245,11 +346,18 @@ class DeviceWorker:
             # bytes per dropout in ring mode).
             return ({"meta": {"client_id": self.client_id,
                               "n_dropped_pairs": 0}}, None)
-        mask = sa.pairwise_mask(
-            template, self._key,
-            jnp.asarray(self.client_id, jnp.int32), mine,
-            jnp.asarray(round_idx, jnp.int32),
-        )
+        if self._dh_mode:
+            pair_keys, signs = self._dh_pair_keys(mine, round_idx)
+            mask = sa.pairwise_mask_with_keys(
+                template, pair_keys, signs,
+                jnp.asarray(round_idx, jnp.int32),
+            )
+        else:
+            mask = sa.pairwise_mask(
+                template, self._key,
+                jnp.asarray(self.client_id, jnp.int32), mine,
+                jnp.asarray(round_idx, jnp.int32),
+            )
         return ({"meta": {"client_id": self.client_id,
                           "n_dropped_pairs": int(mine.size)}},
                 jax.tree.map(np.asarray, mask))

@@ -201,7 +201,7 @@ class FederatedLearner:
             np.asarray(self.dataset.x_train), labels, parts,
             capacity=c.data.max_examples_per_client,
         )
-        self.real_num_clients = shards.num_clients
+        self.real_num_clients = shards.num_clients   # pre-ghost-padding
         if self.sp:
             seq_len = shards.x.shape[-1]
             if shards.x.ndim != 3:
@@ -224,7 +224,6 @@ class FederatedLearner:
                     f"{self.seq_size}-way {self.seq_axis!r} axis; use "
                     "attn_impl='ring'"
                 )
-        self.real_num_clients = shards.num_clients   # pre-ghost-padding
         if mesh is not None:
             shards = pad_clients_to_multiple(shards, self.clients_size)
             # Interleave so real clients spread evenly across devices (ghost

@@ -8,9 +8,21 @@ and −PRG(k_ij) for every j < i.  Summed over the cohort the masks cancel
 exactly, so the aggregate equals the true sum while any single client's
 submitted update is uniformly masked.
 
-This is the honest-but-curious core of the protocol (no dropout-recovery
-secret sharing); it demonstrates the masking hook the BASELINE north_star
-requires.  Both members of a pair expand bit-identical float32 streams, so
+This module is the masking/cancellation CORE; who can derive the pair
+keys differs by plane, and that difference is the trust model:
+
+- ENGINE plane (simulation): keys derive from the shared experiment seed
+  (utils/prng.pair_mask_key).  One process holds every client anyway, so
+  this only demonstrates the algebra the BASELINE north_star requires.
+- WIRE plane (socket deployment): pair keys come from Diffie-Hellman
+  shared secrets negotiated over the broker (comm/keyexchange.py) and
+  enter through :func:`pairwise_mask_with_keys` — the coordinator holds
+  public keys and masked updates only and CANNOT unmask any single
+  client (tests/test_comm.py pins this).  An ACTIVE broker-controlling
+  attacker could still MITM the exchange; authenticated enrollment is
+  out of scope and documented.
+
+Both members of a pair expand bit-identical float32 streams, so
 cancellation is exact up to float32 summation rounding (residual ~1e-7·std
 per element — negligible against typical 1e-3-scale deltas).
 
@@ -112,6 +124,40 @@ def mask_update(update, base_key: jax.Array, client_id, partner_ids, round_idx,
     """Add this client's pairwise mask to its update (before aggregation)."""
     mask = pairwise_mask(update, base_key, client_id, partner_ids, round_idx,
                          std)
+    return pytrees.tree_add(update, mask)
+
+
+def pairwise_mask_with_keys(template, pair_keys: jax.Array, signs: jax.Array,
+                            round_idx, std: float = 1.0):
+    """Pairwise mask from EXPLICIT per-pair PRNG keys — the wire-plane
+    path, where pair keys come from Diffie-Hellman shared secrets
+    (comm/keyexchange.py) that the coordinator cannot derive, instead of
+    the shared experiment seed.
+
+    ``pair_keys``: (P, 2) uint32 key-data rows, one per partner
+    (symmetric: both pair members hold the identical row).
+    ``signs``: (P,) float — +1 where this client's id is lower than the
+    partner's, −1 where higher, 0 for the self-pair; the same ordering
+    convention as :func:`pairwise_mask`, so summed over the cohort the
+    masks cancel exactly.  The round index is folded into each key here,
+    so one key exchange covers every round.
+    """
+    zeros = pytrees.tree_zeros_like(template)
+
+    def body(j, acc):
+        k = jax.random.fold_in(pair_keys[j], round_idx)
+        noise = _sample_tree(template, k, std)
+        return jax.tree.map(
+            lambda a, n: a + signs[j].astype(n.dtype) * n, acc, noise
+        )
+
+    return jax.lax.fori_loop(0, pair_keys.shape[0], body, zeros)
+
+
+def mask_update_with_keys(update, pair_keys: jax.Array, signs: jax.Array,
+                          round_idx, std: float = 1.0):
+    """Explicit-key variant of :func:`mask_update` (wire plane / DH)."""
+    mask = pairwise_mask_with_keys(update, pair_keys, signs, round_idx, std)
     return pytrees.tree_add(update, mask)
 
 

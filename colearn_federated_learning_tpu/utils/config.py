@@ -88,6 +88,14 @@ class FedConfig:
     dp_bit_noise: float = 0.0         # σ_b on the bit sum; 0 = cohort/20
     secure_agg: bool = False
     secure_agg_neighbors: int = 0     # 0 = all-pairs masks; k = random ring
+    # WIRE-plane pair-key agreement (comm/keyexchange.py): "dh" (default)
+    # negotiates per-pair Diffie-Hellman secrets over the broker so the
+    # coordinator cannot unmask any single client; "shared_seed" derives
+    # pair keys from the experiment seed (coordinator-trusted — only
+    # appropriate when the aggregator is trusted or for broker-less
+    # tests).  The ENGINE plane ignores this: a simulation holds every
+    # client in one process regardless.
+    secure_agg_key_exchange: str = "dh"   # dh | shared_seed
     # Update compression on the wire/file planes (fed/compression.py).
     compress: str = "none"            # none | int8 | topk
 

@@ -356,6 +356,155 @@ def test_socket_secure_agg_masks_cancel():
     np.testing.assert_allclose(masked, plain, atol=2e-4)
 
 
+def test_coordinator_view_cannot_unmask_dh():
+    # THE secure-aggregation property: with DH key agreement (the wire
+    # default), everything the coordinator holds — the experiment seed,
+    # every public key, and a single client's masked wire update — is NOT
+    # enough to recover that client's delta.  A pair MEMBER (holding a
+    # private key) can cancel its own pair's mask; the coordinator's
+    # shared-seed derivation (the round-3 attack) recovers nothing.
+    import jax
+    import jax.numpy as jnp
+
+    from colearn_federated_learning_tpu.comm import keyexchange
+    from colearn_federated_learning_tpu.comm.enrollment import (
+        fetch_device_info,
+    )
+    from colearn_federated_learning_tpu.privacy import secure_agg as sa
+    from colearn_federated_learning_tpu.utils import prng
+
+    cfg = _config(num_clients=2, secure_agg=True)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(2)
+        ]
+        try:
+            # Plain (unmasked) reference delta for worker 0.
+            cfg_plain = _config(num_clients=2, secure_agg=False)
+            ref = DeviceWorker(cfg_plain, 0).start()
+            try:
+                client = TensorClient(ref.host, ref.port)
+                params = ref._template_params()
+                _, true_delta = client.request(
+                    {"op": "train", "round": 0}, params)
+                client.close()
+            finally:
+                ref.stop()
+
+            # Worker 0's MASKED wire update (what the coordinator sees).
+            client = TensorClient(workers[0].host, workers[0].port)
+            _, masked = client.request(
+                {"op": "train", "round": 0, "cohort": [0, 1]}, params)
+            client.close()
+
+            flat = lambda t: np.concatenate(  # noqa: E731
+                [np.ravel(np.asarray(l)) for l in jax.tree.leaves(t)])
+            true_f, masked_f = flat(true_delta), flat(masked)
+            # The mask is real: the wire update is nothing like the delta.
+            assert np.abs(masked_f - true_f).max() > 0.1
+
+            # ATTACK (coordinator's view): shared experiment seed ->
+            # prng.pair_mask_key, the exact derivation the wire plane
+            # used before DH.  Must recover nothing.
+            key = prng.experiment_key(cfg.run.seed)
+            attack_mask = sa.pairwise_mask(
+                jax.tree.map(lambda l: jnp.zeros(l.shape, jnp.float32),
+                             params),
+                key, jnp.asarray(0, jnp.int32),
+                jnp.asarray([0, 1], jnp.int32), jnp.asarray(0, jnp.int32),
+            )
+            attacked = masked_f - flat(attack_mask)
+            assert np.abs(attacked - true_f).max() > 0.1
+
+            # PAIR MEMBER's view: worker 1's private key + worker 0's
+            # public enrollment record -> the pair key -> exact unmask.
+            lookup = BrokerClient(broker.host, broker.port)
+            info0 = fetch_device_info(lookup, "0")
+            lookup.close()
+            secret = keyexchange.shared_secret(
+                workers[1]._dh_priv,
+                keyexchange.decode_public(info0.pubkey),
+            )
+            pair_key = keyexchange.pair_prng_key(secret, 0, 1)
+            member_mask = sa.pairwise_mask_with_keys(
+                jax.tree.map(lambda l: jnp.zeros(l.shape, jnp.float32),
+                             params),
+                jnp.asarray(pair_key)[None, :],
+                jnp.asarray([1.0], jnp.float32),    # sign(1 - 0) from 0's view
+                jnp.asarray(0, jnp.int32),
+            )
+            unmasked = masked_f - flat(member_mask)
+            np.testing.assert_allclose(unmasked, true_f, atol=1e-5)
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_dh_peer_restart_refreshes_pubkey():
+    # A worker that restarts re-enrolls with a FRESH ephemeral keypair.
+    # Peers must pick up the new public key next round (stale cached keys
+    # would expand non-cancelling masks and silently corrupt the sum).
+    # TWO restarts: leftover queued enrollment records from the first
+    # restart must not shadow the second key rotation either.
+    import jax
+
+    def restart_same_port(cfg, broker, w):
+        # The old listener may linger briefly after stop(); retry bind.
+        port = w.port
+        w.stop()
+        for attempt in range(50):
+            try:
+                return DeviceWorker(cfg, 1, broker.host, broker.port,
+                                    port=port).start()
+            except OSError:
+                if attempt == 49:
+                    raise
+                time.sleep(0.1)
+
+    def run(secure):
+        cfg = _config(num_clients=2, secure_agg=secure)
+        with MessageBroker() as broker:
+            w0 = DeviceWorker(cfg, 0, broker.host, broker.port).start()
+            w1 = DeviceWorker(cfg, 1, broker.host, broker.port).start()
+            try:
+                coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                             round_timeout=10.0,
+                                             want_evaluator=False)
+                coord.enroll(min_devices=2, timeout=20.0)
+                coord.run_round()                 # round 0: both healthy
+                for _ in range(2):                # two key rotations
+                    w1 = restart_same_port(cfg, broker, w1)
+                    # Dead socket -> w1 drops, coordinator reconnects...
+                    r_drop = coord.run_round()
+                    assert "1" in r_drop["dropped"], r_drop
+                    # ...and the next round must mask against the FRESH
+                    # public key.
+                    r_ok = coord.run_round()
+                    assert r_ok["completed"] == 2, r_ok
+                out = np.concatenate([
+                    np.ravel(np.asarray(a))
+                    for a in jax.tree.leaves(coord.server_state.params)
+                ])
+                coord.close()
+                return out
+            finally:
+                w0.stop(); w1.stop()
+
+    masked, plain = run(True), run(False)
+    np.testing.assert_allclose(masked, plain, atol=2e-4)
+
+
+def test_dh_worker_requires_broker():
+    with pytest.raises(ValueError, match="broker"):
+        DeviceWorker(_config(num_clients=2, secure_agg=True), 0)
+    # shared_seed mode explicitly accepts the coordinator-trusted setup.
+    w = DeviceWorker(
+        _config(num_clients=2, secure_agg=True,
+                secure_agg_key_exchange="shared_seed"), 0)
+    assert not w._dh_mode
+
+
 def test_socket_secure_agg_dropout_recovery():
     # One worker dies mid-federation: the unmask round must collect the
     # survivors' orphaned mask halves, leaving a CLEAN aggregate of the

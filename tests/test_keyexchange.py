@@ -1,0 +1,44 @@
+"""DH key agreement for wire-plane secure aggregation (comm/keyexchange.py)."""
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.comm import keyexchange as kx
+
+
+def test_shared_secret_symmetry():
+    priv_a, pub_a = kx.generate_keypair()
+    priv_b, pub_b = kx.generate_keypair()
+    assert kx.shared_secret(priv_a, pub_b) == kx.shared_secret(priv_b, pub_a)
+    # A third party's secret differs.
+    priv_c, pub_c = kx.generate_keypair()
+    assert kx.shared_secret(priv_c, pub_a) != kx.shared_secret(priv_a, pub_b)
+
+
+def test_pair_key_symmetric_in_ids_and_distinct_per_pair():
+    priv_a, pub_a = kx.generate_keypair()
+    priv_b, pub_b = kx.generate_keypair()
+    s = kx.shared_secret(priv_a, pub_b)
+    np.testing.assert_array_equal(
+        np.asarray(kx.pair_prng_key(s, 3, 7)),
+        np.asarray(kx.pair_prng_key(s, 7, 3)),
+    )
+    assert not np.array_equal(
+        np.asarray(kx.pair_prng_key(s, 3, 7)),
+        np.asarray(kx.pair_prng_key(s, 3, 8)),
+    )
+
+
+@pytest.mark.parametrize("bad", [0, 1, kx.GROUP14_P - 1, kx.GROUP14_P, -5])
+def test_degenerate_public_keys_rejected(bad):
+    # 0/1/p-1 are the order-1/2 elements of the safe-prime group: accepting
+    # them would force the shared secret into a tiny known set.
+    with pytest.raises(ValueError, match="public key"):
+        kx.validate_public(bad)
+    with pytest.raises(ValueError, match="public key"):
+        kx.shared_secret(12345, bad)
+
+
+def test_encode_decode_roundtrip():
+    _, pub = kx.generate_keypair()
+    assert kx.decode_public(kx.encode_public(pub)) == pub
