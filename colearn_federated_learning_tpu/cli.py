@@ -39,6 +39,13 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--local-steps", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr-schedule", default=None,
+                   choices=["constant", "cosine", "warmup_cosine"],
+                   help="client-lr schedule across rounds "
+                        "(fed/strategies.lr_scale_for_round)")
+    p.add_argument("--warmup-rounds", type=int, default=None)
+    p.add_argument("--lr-min-fraction", type=float, default=None,
+                   help="cosine floor as a fraction of --lr")
     p.add_argument("--momentum", type=float, default=None)
     p.add_argument("--local-optimizer", default=None,
                    choices=["sgd", "adam", "adamw"])
@@ -93,7 +100,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
 
 
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
-             "batch_size", "lr", "momentum", "local_optimizer", "strategy",
+             "batch_size", "lr", "lr_schedule", "warmup_rounds",
+             "lr_min_fraction", "momentum", "local_optimizer", "strategy",
              "prox_mu", "dp_clip", "dp_noise_multiplier", "dp_delta",
              "dp_adaptive_clip", "dp_target_quantile", "dp_clip_lr",
              "dp_bit_noise", "secure_agg", "secure_agg_neighbors",

@@ -33,6 +33,7 @@ from colearn_federated_learning_tpu.comm.transport import TensorServer
 from colearn_federated_learning_tpu.data import registry as data_registry
 from colearn_federated_learning_tpu.data.sharding import pack_client_shards
 from colearn_federated_learning_tpu.fed import setup as setup_lib
+from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu.models import registry as model_registry
 from colearn_federated_learning_tpu.utils import prng
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
@@ -281,6 +282,7 @@ class DeviceWorker:
             params, self._x, self._y, self._count,
             prng.client_round_key(self._key, self.client_id, round_idx),
             jnp.asarray(self._num_steps, jnp.int32),
+            strategies.lr_scale_for_round(self.config.fed, round_idx),
         )
         delta, weight = setup_lib.finalize_client_delta(
             self.config, result, self.client_id, round_idx

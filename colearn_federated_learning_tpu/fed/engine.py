@@ -500,17 +500,22 @@ class FederatedLearner:
         else:
             budgets = jnp.full((self.cohort_size_local,), self.num_steps, jnp.int32)
 
+        # Round-level client-lr schedule factor, computed in-graph from
+        # the round operand (no retrace, no host sync).
+        lr_scale = strategies.lr_scale_for_round(c, round_idx)
+
         if self.scaffold:
             c_i = c_blk                      # already one row per cohort slot
             sres = jax.vmap(
-                self.local_update, in_axes=(None, 0, 0, 0, 0, 0, 0, None)
-            )(params, cx, cy, ccounts, keys, budgets, c_i, control)
+                self.local_update,
+                in_axes=(None, 0, 0, 0, 0, 0, 0, None, None),
+            )(params, cx, cy, ccounts, keys, budgets, c_i, control, lr_scale)
             results = sres.result
         else:
             sres = None
-            results = jax.vmap(self.local_update, in_axes=(None, 0, 0, 0, 0, 0))(
-                params, cx, cy, ccounts, keys, budgets
-            )
+            results = jax.vmap(
+                self.local_update, in_axes=(None, 0, 0, 0, 0, 0, None)
+            )(params, cx, cy, ccounts, keys, budgets, lr_scale)
         deltas = results.delta
         completed = results.completed
         nova_a = None

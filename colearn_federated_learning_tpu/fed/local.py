@@ -144,7 +144,8 @@ def make_local_update(
     if scaffold and lr <= 0.0:
         raise ValueError("scaffold=True requires the client lr")
 
-    def run_steps(global_params, x, y, count, key, step_budget, correction):
+    def run_steps(global_params, x, y, count, key, step_budget, correction,
+                  lr_scale):
         opt_state = optimizer.init(global_params)
         safe_count = jnp.maximum(count, 1)
 
@@ -160,6 +161,12 @@ def make_local_update(
             if correction is not None:
                 grads = pytrees.tree_add(grads, correction)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            if lr_scale is not None:
+                # Round-level lr schedule (strategies.lr_scale_for_round):
+                # scaling the UPDATE equals running at lr·scale for SGD
+                # (+momentum, linear in lr from a zero buffer) and for
+                # Adam (update ∝ lr; grad scaling would be a no-op there).
+                updates = pytrees.tree_scale(updates, lr_scale)
             new_params = optax.apply_updates(params, updates)
             active = t < step_budget
             params = _tree_where(active, new_params, params)
@@ -181,19 +188,27 @@ def make_local_update(
         return result, executed
 
     if not scaffold:
-        def local_update(global_params, x, y, count, key, step_budget):
+        def local_update(global_params, x, y, count, key, step_budget,
+                         lr_scale=None):
             result, _ = run_steps(global_params, x, y, count, key,
-                                  step_budget, None)
+                                  step_budget, None, lr_scale)
             return result
 
         return local_update
 
-    def scaffold_update(global_params, x, y, count, key, step_budget, c_i, c):
+    def scaffold_update(global_params, x, y, count, key, step_budget, c_i, c,
+                        lr_scale=None):
         correction = pytrees.tree_sub(c, c_i)     # grads - c_i + c
         result, executed = run_steps(global_params, x, y, count, key,
-                                     step_budget, correction)
-        # Option II refresh: c_i' = c_i - c + (w_g - w_local)/(K·lr).
-        scale = 1.0 / (jnp.maximum(executed, 1.0) * lr)
+                                     step_budget, correction, lr_scale)
+        # Option II refresh: c_i' = c_i - c + (w_g - w_local)/(K·lr_eff),
+        # where lr_eff folds in the round-level schedule factor.  Past a
+        # zero-floor cosine horizon lr_eff hits 0 while delta is exactly
+        # 0 — clamp so the refresh stays 0/eps = finite instead of 0·inf
+        # = NaN poisoning the variates.
+        lr_eff = lr if lr_scale is None else lr * lr_scale
+        scale = 1.0 / (jnp.maximum(executed, 1.0)
+                       * jnp.maximum(lr_eff, 1e-12))
         c_new = pytrees.tree_add(
             pytrees.tree_sub(c_i, c),
             pytrees.tree_scale(result.delta, -scale),

@@ -76,6 +76,7 @@ def client_update(
         jnp.asarray(shards.counts[0]),
         prng.client_round_key(key, client_id, round_idx),
         jnp.asarray(num_steps, jnp.int32),
+        strategies.lr_scale_for_round(c.fed, round_idx),
     )
     delta, weight = setup_lib.finalize_client_delta(c, result, client_id,
                                                     round_idx)

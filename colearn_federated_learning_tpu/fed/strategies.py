@@ -42,6 +42,42 @@ class ServerState(NamedTuple):
     round_idx: jnp.ndarray    # () int32
 
 
+SCHEDULES = ("constant", "cosine", "warmup_cosine")
+
+
+def lr_scale_for_round(cfg: FedConfig, round_idx) -> jnp.ndarray:
+    """In-graph client-lr factor for ``round_idx`` (traced or plain int).
+
+    The per-step optimizer is built once with ``cfg.lr``; every update it
+    emits is scaled by this factor (fed/local.py), which for SGD(+momentum)
+    and Adam alike equals running the round at ``lr · scale``.  Schedules:
+
+    - constant: returns ``None`` so the scaling branch compiles away
+      entirely (a live ×1.0 operand would cost per-step elementwise work
+      XLA cannot fold).
+    - cosine: half-cosine from 1 to ``lr_min_fraction`` over the config's
+      ``rounds`` horizon.
+    - warmup_cosine: linear ramp over ``warmup_rounds`` (round r trains at
+      (r+1)/warmup — never 0), then the cosine leg over the remainder.
+    """
+    if cfg.lr_schedule not in SCHEDULES:
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}; "
+                         f"use one of {SCHEDULES}")
+    if cfg.lr_schedule == "constant":
+        return None
+    r = jnp.asarray(round_idx, jnp.float32)
+    floor = jnp.float32(cfg.lr_min_fraction)
+    warm = float(cfg.warmup_rounds if cfg.lr_schedule == "warmup_cosine"
+                 else 0)
+    horizon = jnp.maximum(jnp.float32(cfg.rounds) - warm, 1.0)
+    prog = jnp.clip((r - warm) / horizon, 0.0, 1.0)
+    cos = floor + (1.0 - floor) * 0.5 * (1.0 + jnp.cos(jnp.pi * prog))
+    if warm > 0:
+        ramp = jnp.minimum((r + 1.0) / warm, 1.0)
+        return jnp.where(r < warm, ramp, cos)
+    return cos
+
+
 def init_server_state(params, cfg: FedConfig) -> ServerState:
     adaptive = cfg.strategy in ("fedadam", "fedyogi")
     zeros = pytrees.tree_zeros_like(params)

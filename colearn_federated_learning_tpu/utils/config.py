@@ -53,6 +53,15 @@ class FedConfig:
     local_steps: int = 0              # if >0 overrides epochs with a step budget
     batch_size: int = 32
     lr: float = 0.1
+    # Client-lr schedule ACROSS ROUNDS (fed/strategies.lr_scale_for_round):
+    # the per-step optimizer keeps ``lr`` but every update is scaled by an
+    # in-graph factor computed from the round index — warmup ramps over
+    # ``warmup_rounds``, cosine decays over the config's ``rounds`` horizon
+    # to ``lr_min_fraction``·lr.  Constant lr was the round-3 text-config
+    # bottleneck (curves cut off mid-climb).
+    lr_schedule: str = "constant"     # constant | cosine | warmup_cosine
+    warmup_rounds: int = 0
+    lr_min_fraction: float = 0.0      # cosine floor as a fraction of lr
     momentum: float = 0.9
     local_optimizer: str = "sgd"      # sgd | adam | adamw (client-side)
     prox_mu: float = 0.0              # FedProx μ (BASELINE config #3: 0.01)
@@ -170,7 +179,10 @@ CONFIGS: dict[str, ExperimentConfig] = {
         model=ModelConfig(name="bert", num_classes=4, width=768, depth=12,
                           num_heads=12, seq_len=128, dtype="bfloat16"),
         fed=FedConfig(strategy="fedavg", rounds=50, cohort_size=10,
-                      local_epochs=1, batch_size=16, lr=2e-5, momentum=0.0),
+                      local_epochs=1, batch_size=16, lr=5e-5, momentum=0.0,
+                      local_optimizer="adam",
+                      lr_schedule="warmup_cosine", warmup_rounds=5,
+                      lr_min_fraction=0.1),
         run=RunConfig(name="agnews_bert_fedavg"),
     ),
     # 5. "Cross-silo ViT-B/16 on FEMNIST, 3400 clients → v5e-256"
@@ -181,7 +193,9 @@ CONFIGS: dict[str, ExperimentConfig] = {
                           depth=12, num_heads=12, patch_size=16,
                           dtype="bfloat16"),
         fed=FedConfig(strategy="fedavg", rounds=100, cohort_size=256,
-                      local_epochs=1, batch_size=16, lr=0.03, momentum=0.9),
+                      local_epochs=1, batch_size=16, lr=0.03, momentum=0.9,
+                      lr_schedule="warmup_cosine", warmup_rounds=5,
+                      lr_min_fraction=0.05),
         run=RunConfig(name="femnist_vit_cross_silo"),
     ),
 }
