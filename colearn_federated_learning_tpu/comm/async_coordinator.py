@@ -22,6 +22,15 @@ federation.  This coordinator is the buffered-asynchronous alternative
 Workers are completely unchanged: a train request carries the model
 version in the ``round`` field, and the worker's per-(client, round) PRNG
 keys make its minibatch stream deterministic per version.
+
+DP composes with the buffered path: every APPLIED aggregation is charged
+to the RDP accountant as one Gaussian mechanism at its realized effective
+multiplier — the staleness weights enter the sensitivity/noise ratio
+exactly (see ``_charge_privacy``), q = 1 (no subsampling-amplification
+claim: buffer membership is availability-ordered), and discarded updates
+charge nothing (never released).  Restore replays each record's charged
+multiplier.  ``secure_agg`` stays synchronous-only (masks need an agreed
+per-round cohort), as does adaptive clipping (cross-round engine state).
 """
 
 from __future__ import annotations
@@ -61,13 +70,11 @@ class AsyncFederatedCoordinator:
     ):
         if buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
-        if config.fed.dp_clip > 0.0 or config.fed.dp_noise_multiplier > 0.0:
+        if config.fed.dp_adaptive_clip:
             raise NotImplementedError(
-                "asynchronous aggregation with DP is unsupported: the "
-                "staleness-discounted weights break the uniform-weighting "
-                "sensitivity analysis the clip+noise calibration assumes, "
-                "and no async accountant is implemented; use the "
-                "synchronous coordinator for DP runs"
+                "dp_adaptive_clip is engine-only (stateless socket "
+                "participants carry no cross-round clip state); use a "
+                "fixed dp_clip for async DP"
             )
         if config.fed.secure_agg:
             raise NotImplementedError(
@@ -99,6 +106,17 @@ class AsyncFederatedCoordinator:
         self._threads: list[threading.Thread] = []
         self.failures: dict[str, int] = {}
         self._ckpt = None
+        # Async DP accounting: q = 1 (NO amplification-by-subsampling —
+        # buffer membership is availability-ordered, not uniformly
+        # sampled); each APPLIED aggregation is charged as one Gaussian
+        # mechanism at its realized effective multiplier
+        # (see _charge_privacy).
+        from colearn_federated_learning_tpu.privacy.accountant import (
+            RdpAccountant,
+        )
+
+        self.accountant = RdpAccountant.from_config(config.fed,
+                                                    sampling_rate=1.0)
 
     # ------------------------------------------------------------------
     def enroll(self, min_devices: int, timeout: float = 30.0) -> None:
@@ -235,6 +253,7 @@ class AsyncFederatedCoordinator:
                                            self.server_state.params))
         staleness: list[int] = []
         contributors: list[str] = []
+        weights: list[float] = []
         discarded = 0
         stall_deadline = t0 + 2.0 * self.request_timeout
         while len(staleness) < self.buffer_size:
@@ -253,11 +272,12 @@ class AsyncFederatedCoordinator:
             if tau > self.max_staleness:
                 discarded += 1
                 continue
-            folder.add(meta, delta,
-                       weight=float(meta.get("weight", 1.0))
-                       * (1.0 + tau) ** (-self.staleness_exponent))
+            w = (float(meta.get("weight", 1.0))
+                 * (1.0 + tau) ** (-self.staleness_exponent))
+            folder.add(meta, delta, weight=w)
             staleness.append(tau)
             contributors.append(dev_id)
+            weights.append(w)
 
         mean_delta, total_w, mean_loss = folder.mean()
         with self._state_lock:
@@ -286,8 +306,51 @@ class AsyncFederatedCoordinator:
             "total_weight": total_w,
             "agg_time_s": time.perf_counter() - t0,
         }
+        if self.accountant is not None and mean_delta is not None:
+            rec["dp_z_eff"] = self._charge_privacy(weights, contributors)
+            rec["dp_epsilon"] = self.accountant.epsilon()
         self.history.append(rec)
         return rec
+
+    def _charge_privacy(self, weights: list[float],
+                        contributors: list[str]) -> float:
+        """Charge one APPLIED aggregation to the RDP accountant and return
+        the realized effective noise multiplier.
+
+        Mechanism per aggregation: each buffered update was clipped to
+        ``C`` and carries independent per-update Gaussian noise of std
+        ``s = σ·C/√B_cfg`` (setup.finalize_client_delta — B_cfg is the
+        configured cohort), and the release is the weighted mean
+        ``W⁻¹ Σ wᵢ dᵢ``:
+
+        - central noise std: ``√(Σ wᵢ²)·s / W`` (noise is independent
+          per update, including two updates from the same device at
+          distinct versions — distinct dp_keys);
+        - one DEVICE's worst-case influence: ``C · (Σ of ITS weights)/W``
+          — a slow device can land updates for two versions in one
+          buffer, so weights are grouped per device;
+        - effective multiplier:
+          ``z_eff = (σ/√B_cfg) · √(Σ wᵢ²) / max_dev(Σ w)``.
+
+        RDP composes additively over aggregations, and charging EVERY
+        applied aggregation upper-bounds each client's loss (an
+        aggregation without a client costs that client nothing).
+        DISCARDED (too-stale) updates are never released and charge
+        nothing — the trusted-aggregator boundary of central DP.
+        """
+        import math
+
+        c = self.config.fed
+        b_cfg = setup_lib.dp_effective_cohort(self.config)
+        per_dev: dict[str, float] = {}
+        for w, d in zip(weights, contributors):
+            per_dev[d] = per_dev.get(d, 0.0) + w
+        warr = np.asarray(weights, np.float64)
+        z_eff = (c.dp_noise_multiplier / math.sqrt(b_cfg)
+                 * math.sqrt(float(np.sum(warr * warr)))
+                 / max(per_dev.values()))
+        self.accountant.step(1, sampling_rate=1.0, noise_multiplier=z_eff)
+        return float(z_eff)
 
     def evaluate(self) -> dict:
         if self.evaluator is None:
@@ -323,6 +386,19 @@ class AsyncFederatedCoordinator:
         (self.server_state,) = state
         self.history = history
         self.version = step
+        if self.accountant is not None:
+            # The async mechanism varies per aggregation (realized z_eff
+            # depends on the buffer's staleness weights), so the budget is
+            # rebuilt by replaying each record's charged multiplier rather
+            # than the engine's constant-mechanism ``steps`` shortcut.
+            # Reset first so restore is idempotent (a retried restore, or
+            # one on an instance that already aggregated, must not
+            # double-charge the history).
+            self.accountant.steps = 0
+            for rec in history:
+                if "dp_z_eff" in rec:
+                    self.accountant.step(1, sampling_rate=1.0,
+                                         noise_multiplier=rec["dp_z_eff"])
         return step
 
     def fit(self, aggregations: int, log_fn=None,

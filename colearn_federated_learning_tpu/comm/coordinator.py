@@ -268,10 +268,7 @@ class FederatedCoordinator:
             if folded > 0 and not (secure and unmask_failed):
                 import math
 
-                nominal = max(
-                    self.config.fed.cohort_size or self.config.data.num_clients,
-                    1,
-                )
+                nominal = setup_lib.dp_effective_cohort(self.config)
                 sigma_eff = (self.config.fed.dp_noise_multiplier
                              * math.sqrt(min(folded, nominal) / nominal))
                 q = len(cohort) / max(1, len(self.trainers))

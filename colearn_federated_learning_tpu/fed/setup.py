@@ -156,6 +156,15 @@ def init_global_params(config: ExperimentConfig) -> Any:
     )
 
 
+def dp_effective_cohort(config: ExperimentConfig) -> int:
+    """The cohort size the per-client DP noise is calibrated against
+    (``σ·C/√B`` per update so the SUM of B updates carries std ``σ·C``).
+    The ONE definition shared by the noise hook (finalize_client_delta)
+    and every accountant that must match it (sync + async coordinators) —
+    divergence would silently mis-report ε."""
+    return max(config.fed.cohort_size or config.data.num_clients, 1)
+
+
 def finalize_client_delta(
     config: ExperimentConfig, result, client_id: int, round_idx: int
 ) -> tuple[Any, float]:
@@ -179,7 +188,7 @@ def finalize_client_delta(
         key = prng.experiment_key(config.run.seed)
         delta = dp_lib.clip_and_noise(
             delta, c.dp_clip, c.dp_noise_multiplier,
-            max(c.cohort_size or config.data.num_clients, 1),
+            dp_effective_cohort(config),
             prng.dp_key(key, client_id, round_idx),
         )
         weight = 1.0

@@ -82,14 +82,93 @@ def test_async_federation_learns_and_tracks_staleness():
                 w.stop()
 
 
-def test_async_rejects_dp_configs():
+def test_async_rejects_unsupported_configs():
     import pytest
 
+    # secure_agg needs an agreed per-round cohort the pumps don't have.
     with pytest.raises(NotImplementedError, match="synchronous"):
         AsyncFederatedCoordinator(
-            _config(dp_clip=1.0, dp_noise_multiplier=0.5),
+            _config(secure_agg=True), "127.0.0.1", 1,
+        )
+    # adaptive clipping is engine-only cross-round state.
+    with pytest.raises(NotImplementedError, match="engine-only"):
+        AsyncFederatedCoordinator(
+            _config(dp_clip=1.0, dp_noise_multiplier=0.5,
+                    dp_adaptive_clip=True),
             "127.0.0.1", 1,
         )
+
+
+def test_async_charge_privacy_math():
+    # Oracle for the per-aggregation effective multiplier:
+    # z_eff = (sigma/sqrt(B_cfg)) * sqrt(sum w^2) / max_device(sum w).
+    import math
+    import types
+
+    import pytest
+
+    from colearn_federated_learning_tpu.privacy.accountant import (
+        RdpAccountant,
+    )
+
+    cfg = _config(dp_clip=1.0, dp_noise_multiplier=2.0, cohort_size=4)
+    self = types.SimpleNamespace(
+        config=cfg, accountant=RdpAccountant.from_config(cfg.fed, 1.0))
+    charge = AsyncFederatedCoordinator._charge_privacy
+    # Two distinct devices, equal weights: sqrt(2 w^2)/w = sqrt(2).
+    z = charge(self, [1.0, 1.0], ["a", "b"])
+    assert z == pytest.approx((2.0 / 2.0) * math.sqrt(2.0))
+    # SAME device twice (two versions in one buffer): its influence is
+    # the SUM of its weights -> z halves vs the two-device case.
+    z2 = charge(self, [1.0, 1.0], ["a", "a"])
+    assert z2 == pytest.approx((2.0 / 2.0) * math.sqrt(2.0) / 2.0)
+    # Staleness-discounted second update from another device.
+    z3 = charge(self, [1.0, 0.5], ["a", "b"])
+    assert z3 == pytest.approx(math.sqrt(1.25))
+    assert self.accountant.steps == 3
+    assert 0.0 < self.accountant.epsilon() < math.inf
+
+
+def test_async_dp_federation_reports_epsilon(tmp_path):
+    # End to end: buffered-async aggregation WITH clip+noise — every
+    # applied aggregation charges the accountant, epsilon grows
+    # monotonically, and a restored coordinator replays the exact budget.
+    import dataclasses
+
+    cfg = _config(num_clients=3, dp_clip=1.0, dp_noise_multiplier=1.0)
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, checkpoint_dir=str(tmp_path / "ckpt")))
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            with AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=2,
+                want_evaluator=False,
+            ) as coord:
+                coord.enroll(min_devices=3, timeout=20.0)
+                hist = coord.fit(aggregations=4)
+                eps = [r["dp_epsilon"] for r in hist]
+                zs = [r["dp_z_eff"] for r in hist]
+                final_eps = coord.accountant.epsilon()
+                coord.save_checkpoint()
+            assert all(np.isfinite(eps)) and all(z > 0 for z in zs)
+            assert all(b > a for a, b in zip(eps, eps[1:])), eps
+
+            # Resume: the budget is rebuilt by replaying history.
+            coord2 = AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=2,
+                want_evaluator=False,
+            )
+            step = coord2.restore_checkpoint()
+            assert step == 4
+            assert coord2.accountant.epsilon() == final_eps
+            coord2.close()
+        finally:
+            for w in workers:
+                w.stop()
 
 
 def test_async_checkpoint_resume(tmp_path):
