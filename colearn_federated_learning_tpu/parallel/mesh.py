@@ -80,14 +80,21 @@ def make_mesh(
         )
 
     if jax.process_count() > 1:
-        from jax.experimental import mesh_utils
-
         per_host = n // jax.process_count()
         if sizes[0] % jax.process_count() == 0 and per_host:
+            from jax.experimental import mesh_utils
+
             dcn = [jax.process_count()] + [1] * (len(sizes) - 1)
             ici = [sizes[0] // jax.process_count()] + list(sizes[1:])
+            # ``process_is_granule=True`` because our DCN shape counts
+            # PROCESSES: the default granule is the TPU ``slice_index``,
+            # which is one value across a whole single-slice pod (and
+            # absent on CPU multi-process), so the slice-based grouping
+            # could never match a process-shaped dcn_mesh_shape.  Genuine
+            # shape mismatches still raise.
             arr = mesh_utils.create_hybrid_device_mesh(
-                ici, dcn_mesh_shape=dcn, devices=devices
+                ici, dcn_mesh_shape=dcn, devices=devices,
+                process_is_granule=True,
             )
             return Mesh(arr, tuple(axis_names))
     return Mesh(np.array(devices).reshape(sizes), tuple(axis_names))
