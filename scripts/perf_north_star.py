@@ -3,8 +3,15 @@
 Runs the real engine on whatever accelerator is present: 1000 clients,
 cohort >= 64, width-64 bf16 CNN, jit-compiled local SGD, FedAvg in-XLA.
 Reports rounds/sec, client-samples/sec/chip, HBM usage, and an MFU estimate
-from XLA's own cost analysis of the compiled round program.  Results feed
-PERF.md; run with --profile-dir to also capture a jax.profiler trace.
+from XLA's own cost analysis of the compiled round program.  Run with
+--profile-dir to also capture a jax.profiler trace.
+
+Every run writes a RAW record file ``results/perf_<shape>.jsonl`` (override
+with --out): one ``meta`` line (device kind, shape, cost_analysis FLOPs,
+compile/build timings, HBM), one line per timed round (dispatch timestamps
+in the pipelined mode; true per-round latencies with --sync-per-round), and
+a closing ``summary`` line.  PERF.md table rows cite these files — every
+number must be traceable to a committed record.
 
     python scripts/perf_north_star.py [--rounds 20] [--cohort 64]
 """
@@ -34,10 +41,30 @@ def main() -> None:
     p.add_argument("--examples-per-client", type=int, default=64)
     p.add_argument("--rounds", type=int, default=20)
     p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--stem", default="conv",
+                   choices=["conv", "space_to_depth"],
+                   help="CNN stem MFU lever (models/cnn.py)")
+    p.add_argument("--norm", default="group", choices=["group", "none"],
+                   help="CNN norm MFU lever")
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--sync-per-round", action="store_true",
+                   help="block on every round for TRUE per-round "
+                        "latencies (disables the on-device pipelining "
+                        "the headline number uses)")
+    p.add_argument("--out", default=None,
+                   help="raw JSONL record path (default: "
+                        "results/perf_c<cohort>_w<width>_n<clients>.jsonl)")
     args = p.parse_args()
 
     import jax
+
+    # The sandbox boot pins JAX_PLATFORMS=axon before user code runs, so
+    # the env var alone cannot select CPU; honor an explicit cpu request
+    # the way tests/conftest.py does (a hung tunnel otherwise blocks the
+    # script forever).
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from colearn_federated_learning_tpu.data import registry as data_registry
@@ -55,7 +82,7 @@ def main() -> None:
                         partition="dirichlet", dirichlet_alpha=0.5,
                         max_examples_per_client=args.examples_per_client),
         model=ModelConfig(name="cnn", num_classes=10, width=args.width,
-                          dtype="bfloat16"),
+                          dtype="bfloat16", stem=args.stem, norm=args.norm),
         fed=FedConfig(strategy="fedavg", cohort_size=args.cohort,
                       local_steps=args.local_steps, batch_size=args.batch,
                       lr=0.05, momentum=0.9),
@@ -72,9 +99,12 @@ def main() -> None:
 
     # XLA's own FLOP count for one compiled round (forward+backward+opt).
     t0 = time.perf_counter()
+    # Mirror run_round's ACTUAL operands (a None where run_round passes
+    # the dp_clip scalar would time-compile a variant that never runs).
     lowered = learner._round_fn.lower(
         learner.server_state, learner.base_key, jnp.asarray(0, jnp.int32),
         *learner._device_data, None, None,
+        getattr(learner, "_dp_clip", None),
     )
     compiled = lowered.compile()
     compile_s = time.perf_counter() - t0
@@ -92,25 +122,68 @@ def main() -> None:
         learner.run_round()
     learner.finalize_history()                      # true device sync
 
-    # sync=False keeps the host out of the loop: rounds pipeline on-device
-    # and the final finalize (a host read of round metrics) is the barrier.
-    # (block_until_ready does not reliably block on the remote-tunnel
-    # platform, and a per-round float() costs one RPC round-trip.)
+    mem = dev.memory_stats() or {}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tag = (f"perf_c{learner.cohort_size}_w{args.width}_n{args.num_clients}"
+           f"_k{learner.num_steps}_b{args.batch}_e{args.examples_per_client}"
+           f"{'_s2d' if args.stem == 'space_to_depth' else ''}"
+           f"{'_nonorm' if args.norm == 'none' else ''}"
+           f"{'_sync' if args.sync_per_round else ''}")
+    out_path = args.out or os.path.join(repo, "results", f"{tag}.jsonl")
+    out_dir = os.path.dirname(out_path)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    rec_f = open(out_path, "w")
+
+    def rec(obj):
+        rec_f.write(json.dumps(obj) + "\n")
+
+    rec({
+        "kind": "meta",
+        "recorded_unix": int(time.time()),
+        "device": dev.device_kind,
+        "platform": dev.platform,
+        "n_devices": len(jax.devices()),
+        "num_clients": args.num_clients,
+        "cohort": learner.cohort_size,
+        "local_steps": learner.num_steps,
+        "batch": args.batch,
+        "width": args.width,
+        "stem": args.stem,
+        "norm": args.norm,
+        "examples_per_client": args.examples_per_client,
+        "build_s": round(build_s, 2),
+        "compile_s": round(compile_s, 2),
+        "cost_analysis_flops_per_round": flops_per_round,
+        "hbm_used_gb": round(mem.get("bytes_in_use", 0) / 2**30, 3),
+        "hbm_limit_gb": round(mem.get("bytes_limit", 0) / 2**30, 3),
+        "timing_mode": ("sync_per_round" if args.sync_per_round
+                        else "pipelined"),
+    })
+
+    # Pipelined (default): rounds queue on-device, the closing finalize (a
+    # host read of round metrics) is the barrier — per-round stamps are
+    # DISPATCH times, only the total is a latency.  (block_until_ready
+    # does not reliably block on the remote-tunnel platform, and a
+    # per-round float() costs one RPC round-trip.)  --sync-per-round
+    # instead blocks each round for true per-round latencies.
     t0 = time.perf_counter()
-    for _ in range(args.rounds):
-        learner.run_round(sync=False)
+    for i in range(args.rounds):
+        r0 = time.perf_counter()
+        learner.run_round(sync=args.sync_per_round)
+        rec({"kind": "round", "round": i,
+             ("round_s" if args.sync_per_round else "dispatch_s"):
+             round(time.perf_counter() - r0, 6)})
     learner.finalize_history()
     dt = time.perf_counter() - t0
     rps = args.rounds / dt
 
     samples_per_round = learner.cohort_size * learner.num_steps * args.batch
-    mem = dev.memory_stats() or {}
-    hbm_used = mem.get("bytes_in_use", 0)
-    hbm_limit = mem.get("bytes_limit", 0)
     peak = PEAK_BF16_FLOPS.get(dev.device_kind, 0)
     mfu = (flops_per_round * rps / peak) if peak else 0.0
 
     out = {
+        "kind": "summary",
         "device": dev.device_kind,
         "platform": dev.platform,
         "num_clients": args.num_clients,
@@ -118,15 +191,16 @@ def main() -> None:
         "local_steps": learner.num_steps,
         "batch": args.batch,
         "width": args.width,
-        "build_s": round(build_s, 2),
-        "compile_s": round(compile_s, 2),
+        "rounds_timed": args.rounds,
+        "total_s": round(dt, 4),
         "rounds_per_sec": round(rps, 4),
         "client_samples_per_sec_per_chip": round(rps * samples_per_round, 1),
         "flops_per_round": flops_per_round,
         "model_flops_utilization": round(mfu, 4),
-        "hbm_used_gb": round(hbm_used / 2**30, 3),
-        "hbm_limit_gb": round(hbm_limit / 2**30, 3),
     }
+    rec(out)
+    rec_f.close()
+    print(f"[perf] raw record -> {out_path}", file=sys.stderr)
     print(json.dumps(out))
 
 
