@@ -39,7 +39,8 @@ def build_model(cfg: ModelConfig, seq_axis_name: str | None = None):
     if cfg.name == "cnn":
         from colearn_federated_learning_tpu.models.cnn import CNN
 
-        return CNN(num_classes=cfg.num_classes, width=cfg.width, dtype=dtype)
+        return CNN(num_classes=cfg.num_classes, width=cfg.width, dtype=dtype,
+                   stem=cfg.stem, norm=cfg.norm)
     if cfg.name == "resnet18":
         from colearn_federated_learning_tpu.models.resnet import ResNet18
 

@@ -42,6 +42,16 @@ class ModelConfig:
     # trades recompute FLOPs for activation HBM — how deep models fit
     # long local training on a chip.
     remat: bool = False
+    # CNN MFU levers (PERF.md §1: the north-star CNN sits near 25% MFU
+    # with an op-mix explanation — the 3-channel stem conv wastes the
+    # MXU's 128-lane contraction dim and GroupNorm is bandwidth-bound):
+    # - stem="space_to_depth": fold 2x2 spatial patches into channels
+    #   (32x32x3 -> 16x16x12) before the first conv — 4x fewer positions,
+    #   4x more contraction channels, same receptive-field economics.
+    # - norm="none": drop GroupNorm entirely (measure accuracy cost).
+    # Defaults preserve the measured baseline model exactly.
+    stem: str = "conv"                # conv | space_to_depth (CNN)
+    norm: str = "group"               # group | none (CNN)
 
 
 @dataclasses.dataclass(frozen=True)
