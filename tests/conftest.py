@@ -45,6 +45,11 @@ try:
                           ".jax_test_cache", _hostkey)
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # Export via env too: the multi-process tests (CLI federation, DCN
+    # children) spawn fresh interpreters that would otherwise recompile
+    # every program from scratch — the single biggest suite cost.
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
 except Exception:
     pass
 

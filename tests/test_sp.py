@@ -32,7 +32,9 @@ BERT_CFG = ModelConfig(name="bert", num_classes=4, width=16, depth=2,
                        num_heads=2, seq_len=32, vocab_size=200)
 
 
-def _models_and_params():
+@pytest.fixture(scope="module")
+def models_and_params():
+    # Module-scoped: model build + init compile once for both oracle tests.
     dense = model_registry.build_model(BERT_CFG)
     sp = model_registry.build_model(
         dataclasses.replace(BERT_CFG, attn_impl="ring"), seq_axis_name="seq"
@@ -42,18 +44,18 @@ def _models_and_params():
     return dense, sp, ids, params
 
 
-def test_sp_forward_matches_dense(cpu_devices):
+def test_sp_forward_matches_dense(cpu_devices, models_and_params):
     mesh = make_mesh(("seq",), (4,), devices=cpu_devices[:4])
-    dense, sp, ids, params = _models_and_params()
+    dense, sp, ids, params = models_and_params
     y_ref = dense.apply({"params": params}, ids, train=False)
     y_sp = make_sp_apply(sp, mesh)(params, ids)
     np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_ref),
                                rtol=1e-4, atol=1e-4)
 
 
-def test_sp_grads_match_dense(cpu_devices):
+def test_sp_grads_match_dense(cpu_devices, models_and_params):
     mesh = make_mesh(("seq",), (4,), devices=cpu_devices[:4])
-    dense, sp, ids, params = _models_and_params()
+    dense, sp, ids, params = models_and_params
     labels = jnp.array([0, 1, 2, 3])
 
     def dense_loss(p):
