@@ -109,6 +109,9 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--out", default="results")
     p.add_argument("--only", default=None)
+    p.add_argument("--rounds", type=int, default=None,
+                   help="override every selected config's round count "
+                        "(e.g. run the text configs to plateau)")
     args = p.parse_args()
 
     import jax
@@ -121,6 +124,9 @@ def main() -> None:
     for name, (cfg, note) in scaled_variants().items():
         if args.only and name != args.only:
             continue
+        if args.rounds:
+            cfg = cfg.replace(
+                fed=dataclasses.replace(cfg.fed, rounds=args.rounds))
         print(f"[{name}] {note}", file=sys.stderr)
         t0 = time.perf_counter()
         learner = FederatedLearner.from_config(cfg)

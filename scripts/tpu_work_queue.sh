@@ -1,0 +1,52 @@
+#!/bin/bash
+# Round-4 TPU measurement queue: run EVERYTHING that was blocked on the
+# tunnel, in priority order, as soon as an accelerator answers.  Safe to
+# re-run; each step is independent and failures don't stop the queue.
+#
+#   bash scripts/tpu_work_queue.sh [results_dir]
+#
+# 1. bench.py live capture (regenerates results/bench_tpu.json with the
+#    headline ratio + provenance).
+# 2. perf_north_star sweeps: cohort 1 / 64 / 256 baselines, then the
+#    stem/norm MFU A/B at cohort 64 — all writing results/perf_*.jsonl.
+# 3. Real-TPU flash kernel regression (tests/test_flash_tpu.py).
+# 4. Text-config re-runs to plateau with the round-4 lr schedules
+#    (agnews_bert_fedavg, femnist_vit_cross_silo via
+#    scripts/run_baseline_configs.py if present).
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-results}/tpu_queue_$(date +%H%M%S).log
+mkdir -p "$(dirname "$LOG")"
+echo "[queue] logging to $LOG"
+
+probe() {
+  timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.platform)" \
+    2>/dev/null | tail -1
+}
+
+plat=$(probe)
+if [ "$plat" != "tpu" ]; then
+  echo "[queue] accelerator probe -> '$plat'; aborting (tunnel down)"
+  exit 1
+fi
+echo "[queue] TPU up — running the measurement queue" | tee -a "$LOG"
+
+run() {
+  echo "== $* ==" | tee -a "$LOG"
+  timeout 1800 "$@" >>"$LOG" 2>&1
+  echo "rc=$?" | tee -a "$LOG"
+}
+
+run python bench.py
+run python scripts/perf_north_star.py --rounds 100 --cohort 1
+run python scripts/perf_north_star.py --rounds 30 --cohort 64
+run python scripts/perf_north_star.py --rounds 20 --cohort 256
+run python scripts/perf_north_star.py --rounds 30 --cohort 64 --stem space_to_depth
+run python scripts/perf_north_star.py --rounds 30 --cohort 64 --norm none
+run python scripts/perf_north_star.py --rounds 30 --cohort 64 --stem space_to_depth --norm none
+run python -m pytest tests/test_flash_tpu.py -q
+if [ -f scripts/run_baseline_configs.py ]; then
+  run python scripts/run_baseline_configs.py --only agnews_bert_fedavg --rounds 40
+  run python scripts/run_baseline_configs.py --only femnist_vit_cross_silo --rounds 40
+fi
+echo "[queue] done; see $LOG and results/*.jsonl"
