@@ -94,6 +94,13 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--attn-impl", default=None,
                    choices=["dense", "flash", "ring", "ulysses"],
                    help="attention core (models/attention.py)")
+    p.add_argument("--width", type=int, default=None,
+                   help="model width override (CNN channels / embed dim)")
+    p.add_argument("--stem", default=None,
+                   choices=["conv", "space_to_depth"],
+                   help="CNN stem MFU lever (models/cnn.py)")
+    p.add_argument("--norm", default=None, choices=["group", "none"],
+                   help="CNN normalization (group | none)")
     p.add_argument("--remat", action="store_true", default=None,
                    help="rematerialize transformer blocks (jax.checkpoint): "
                         "activation HBM ~depth -> ~1 block")
@@ -108,7 +115,7 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "straggler_prob", "compress", "aggregator", "trim_fraction",
              "edge_groups", "edge_sync_period"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
-_MODEL_KEYS = {"attn_impl", "remat"}
+_MODEL_KEYS = {"attn_impl", "remat", "stem", "norm", "width"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
              "checkpoint_every", "profile_dir"}
 
