@@ -179,6 +179,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             flag for flag, on in [
                 ("--resume", args.resume),
                 ("--per-client-eval", args.per_client_eval),
+                ("--detection-eval", args.detection_eval),
                 ("--personalize-steps", bool(args.personalize_steps)),
                 ("--checkpoint-dir", bool(config.run.checkpoint_dir)),
                 ("--profile-dir", bool(config.run.profile_dir)),
@@ -217,18 +218,19 @@ def cmd_train(args: argparse.Namespace) -> int:
             print(json.dumps(rec), file=sys.stderr)
 
         learner.fit(log_fn=log_fn)
+        def dump_report(rep):
+            print(json.dumps({
+                k: (v.tolist() if hasattr(v, "tolist") else v)
+                for k, v in rep.items()
+            }), file=sys.stderr)
+
         if args.per_client_eval:
-            rep = learner.evaluate_per_client()
-            print(json.dumps({
-                k: (v.tolist() if hasattr(v, "tolist") else v)
-                for k, v in rep.items()
-            }), file=sys.stderr)
+            dump_report(learner.evaluate_per_client())
         if args.personalize_steps:
-            rep = learner.evaluate_personalized(steps=args.personalize_steps)
-            print(json.dumps({
-                k: (v.tolist() if hasattr(v, "tolist") else v)
-                for k, v in rep.items()
-            }), file=sys.stderr)
+            dump_report(
+                learner.evaluate_personalized(steps=args.personalize_steps))
+        if args.detection_eval:
+            dump_report(learner.evaluate_detection())
         samples = (learner.cohort_size * learner.num_steps
                    * config.fed.batch_size)
         n_chips = learner.mesh.devices.size if learner.mesh is not None else 1
@@ -385,6 +387,11 @@ def main(argv: list[str] | None = None) -> int:
                          help="fine-tune-then-eval personalization probe: "
                               "N local SGD steps per client on half its "
                               "shard, scored on the held-out half")
+    p_train.add_argument("--detection-eval", action="store_true",
+                         help="detection-oriented held-out report "
+                              "(per-class P/R/F1, alarm detection/"
+                              "false-alarm rates — the IoT anomaly "
+                              "metrics; class 0 = benign)")
     p_train.set_defaults(fn=cmd_train)
 
     p_init = sub.add_parser("init", help="write an initial global model file")

@@ -34,7 +34,11 @@ from colearn_federated_learning_tpu.data.sharding import (
 )
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
-from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
+from colearn_federated_learning_tpu.fed.evaluation import (
+    detection_report,
+    make_confusion_eval_fn,
+    make_eval_fn,
+)
 from colearn_federated_learning_tpu.models import registry as model_registry
 from colearn_federated_learning_tpu.privacy import dp as dp_lib
 from colearn_federated_learning_tpu.privacy import secure_agg as sa_lib
@@ -1011,6 +1015,24 @@ class FederatedLearner:
     def evaluate(self) -> tuple[float, float]:
         loss, acc = self._eval_fn(self.server_state.params)
         return float(loss), float(acc)
+
+    def evaluate_detection(self, benign_class: int = 0) -> dict:
+        """Detection-oriented held-out report (per-class P/R/F1, macro-F1,
+        alarm detection/false-alarm rates) — the metrics the reference's
+        IoT anomaly deployment cares about, where accuracy alone hides an
+        always-benign classifier.  One jit scan accumulating the global
+        confusion matrix; host-side summarization
+        (fed/evaluation.detection_report)."""
+        if not hasattr(self, "_conf_eval_fn"):
+            self._conf_eval_fn = make_confusion_eval_fn(
+                self.eval_model.apply,
+                self.dataset.x_test,
+                self.dataset.y_test,
+                batch=max(self.config.fed.batch_size, 64),
+                num_classes=self.config.model.num_classes,
+            )
+        conf = np.asarray(self._conf_eval_fn(self.server_state.params))
+        return detection_report(conf, benign_class=benign_class)
 
     # ---- federated (per-client) evaluation ---------------------------
     def evaluate_per_client(self) -> dict:

@@ -14,24 +14,29 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def make_eval_fn(apply_fn: Callable, x_test, y_test, batch: int) -> Callable:
-    """Build ``eval_fn(params) -> (mean_loss, accuracy)`` over the test set.
-
-    The set is padded to a whole number of ``batch``-sized chunks with a
-    validity mask, then reduced in a single ``lax.scan`` — static shapes,
-    one compile.
-    """
+def _pad_batches(x_test, y_test, batch: int):
+    """(xb, yb, mb) device arrays: the test set padded to whole
+    ``batch``-sized chunks with a validity mask — static shapes, shared
+    by every eval builder in this module."""
     x_test = np.asarray(x_test)
     y_test = np.asarray(y_test)
     n = len(x_test)
     n_batches = int(np.ceil(n / batch))
     pad = n_batches * batch - n
-    x_pad = np.concatenate([x_test, np.zeros((pad,) + x_test.shape[1:], x_test.dtype)])
+    x_pad = np.concatenate(
+        [x_test, np.zeros((pad,) + x_test.shape[1:], x_test.dtype)])
     y_pad = np.concatenate([y_test, np.zeros((pad,), y_test.dtype)])
     mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
     xb = jnp.asarray(x_pad.reshape((n_batches, batch) + x_test.shape[1:]))
     yb = jnp.asarray(y_pad.reshape((n_batches, batch)))
     mb = jnp.asarray(mask.reshape((n_batches, batch)))
+    return xb, yb, mb
+
+
+def make_eval_fn(apply_fn: Callable, x_test, y_test, batch: int) -> Callable:
+    """Build ``eval_fn(params) -> (mean_loss, accuracy)`` over the test set,
+    reduced in a single ``lax.scan`` — one compile."""
+    xb, yb, mb = _pad_batches(x_test, y_test, batch)
 
     @jax.jit
     def eval_fn(params):
@@ -54,6 +59,69 @@ def make_eval_fn(apply_fn: Callable, x_test, y_test, batch: int) -> Callable:
         return loss_sum / m_sum, acc_sum / m_sum
 
     return eval_fn
+
+
+def make_confusion_eval_fn(apply_fn: Callable, x_test, y_test, batch: int,
+                           num_classes: int) -> Callable:
+    """Build ``fn(params) -> (C, C) confusion matrix`` (rows = true class,
+    cols = prediction) over the test set — same padded-scan structure as
+    :func:`make_eval_fn`, accumulating one scatter-add per batch."""
+    xb, yb, mb = _pad_batches(x_test, y_test, batch)
+    C = num_classes
+
+    @jax.jit
+    def conf_fn(params):
+        def step(conf, inp):
+            x, y, m = inp
+            logits = apply_fn({"params": params}, x, train=False)
+            pred = jnp.argmax(logits, axis=-1)
+            flat = y.astype(jnp.int32) * C + pred.astype(jnp.int32)
+            return conf.at[flat].add(m), None
+
+        conf, _ = jax.lax.scan(step, jnp.zeros(C * C, jnp.float32),
+                               (xb, yb, mb))
+        return conf.reshape(C, C)
+
+    return conf_fn
+
+
+def detection_report(conf: np.ndarray, benign_class: int = 0) -> dict:
+    """Detection-oriented metrics from a confusion matrix — the quantities
+    the reference's IoT network-anomaly deployment actually cares about
+    (SURVEY.md §0: MUD-compliant edge anomaly detection), where plain
+    accuracy hides a useless always-benign classifier:
+
+    - per-class precision/recall/F1 + macro-F1;
+    - binary ALARM view (any non-benign prediction is an alarm):
+      ``detection_rate`` = P(alarm | attack), ``false_alarm_rate`` =
+      P(alarm | benign).
+    """
+    conf = np.asarray(conf, np.float64)
+    C = conf.shape[0]
+    tp = np.diag(conf)
+    support = conf.sum(axis=1)
+    predicted = conf.sum(axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        precision = np.where(predicted > 0, tp / predicted, 0.0)
+        recall = np.where(support > 0, tp / support, 0.0)
+        f1 = np.where(precision + recall > 0,
+                      2 * precision * recall / (precision + recall), 0.0)
+    attack = np.arange(C) != benign_class
+    attack_total = conf[attack].sum()
+    benign_total = conf[benign_class].sum()
+    alarms_on_attack = conf[attack][:, attack].sum()
+    alarms_on_benign = conf[benign_class, attack].sum()
+    return {
+        "accuracy": float(tp.sum() / max(conf.sum(), 1.0)),
+        "per_class_precision": precision,
+        "per_class_recall": recall,
+        "per_class_f1": f1,
+        "macro_f1": float(f1[support > 0].mean()) if (support > 0).any()
+        else 0.0,
+        "detection_rate": float(alarms_on_attack / max(attack_total, 1.0)),
+        "false_alarm_rate": float(alarms_on_benign / max(benign_total, 1.0)),
+        "support": support,
+    }
 
 
 def summarize_per_client(losses, accs, counts) -> dict:
