@@ -292,8 +292,12 @@ def cmd_worker(args: argparse.Namespace) -> int:
     if args.client_id is None:
         print("worker requires --client-id", file=sys.stderr)
         return 2
+    mud = None
+    if args.mud_profile:
+        with open(args.mud_profile) as f:
+            mud = f.read()
     run_worker_forever(config, args.client_id, args.broker_host,
-                       args.broker_port)
+                       args.broker_port, mud_profile=mud)
     return 0
 
 
@@ -303,6 +307,16 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
     )
 
     config = config_from_args(args)
+    mud_policy = None
+    if args.mud_require_profile or args.mud_allowed_types:
+        from colearn_federated_learning_tpu.comm.mud import MudPolicy
+
+        mud_policy = MudPolicy(
+            require_profile=args.mud_require_profile,
+            allowed_types=tuple(
+                t for t in (args.mud_allowed_types or "").split(",") if t
+            ),
+        )
     if args.async_buffer:
         from colearn_federated_learning_tpu.comm.async_coordinator import (
             AsyncFederatedCoordinator,
@@ -313,6 +327,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             buffer_size=args.async_buffer,
             request_timeout=args.round_timeout,
             want_evaluator=not args.no_evaluator,
+            mud_policy=mud_policy,
         )
         with coord:
             if args.resume:
@@ -330,7 +345,8 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
         return 0
     coord = FederatedCoordinator(config, args.broker_host, args.broker_port,
                                  round_timeout=args.round_timeout,
-                                 want_evaluator=not args.no_evaluator)
+                                 want_evaluator=not args.no_evaluator,
+                                 mud_policy=mud_policy)
     with coord:
         if args.resume:
             step = coord.restore_checkpoint()
@@ -426,6 +442,9 @@ def main(argv: list[str] | None = None) -> int:
     p_worker.add_argument("--client-id", type=int, default=None)
     p_worker.add_argument("--broker-host", default="127.0.0.1")
     p_worker.add_argument("--broker-port", type=int, required=True)
+    p_worker.add_argument("--mud-profile", default=None,
+                          help="path to this device's RFC 8520 MUD JSON, "
+                               "announced on enrollment (comm/mud.py)")
     p_worker.set_defaults(fn=cmd_worker)
 
     p_coord = sub.add_parser("coordinate",
@@ -446,6 +465,12 @@ def main(argv: list[str] | None = None) -> int:
     p_coord.add_argument("--per-client-eval", action="store_true",
                          help="report each trainer's own-shard accuracy "
                               "after training (worker self_eval op)")
+    p_coord.add_argument("--mud-require-profile", action="store_true",
+                         help="refuse devices that enroll without an RFC "
+                              "8520 MUD profile (comm/mud.py)")
+    p_coord.add_argument("--mud-allowed-types", default=None,
+                         help="comma-separated device types admitted to "
+                              "the federation (MUD colearn:device-type)")
     p_coord.add_argument("--async-buffer", type=int, default=0,
                          help="> 0 switches to buffered-asynchronous "
                               "aggregation (FedBuff-style): apply the "

@@ -67,6 +67,7 @@ class AsyncFederatedCoordinator:
         max_staleness: int = 10,
         request_timeout: float = 60.0,
         want_evaluator: bool = True,
+        mud_policy=None,
     ):
         if buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
@@ -91,7 +92,7 @@ class AsyncFederatedCoordinator:
         self.request_timeout = request_timeout
         self.want_evaluator = want_evaluator
         self._broker = BrokerClient(broker_host, broker_port)
-        self._enroll = EnrollmentManager(self._broker)
+        self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy)
         params = setup_lib.init_global_params(config)
         self.server_state = strategies.init_server_state(params, config.fed)
         self.version = 0                       # server model version t

@@ -44,7 +44,10 @@ class FederatedCoordinator:
         broker_port: int,
         round_timeout: float = 60.0,
         want_evaluator: bool = True,
+        mud_policy=None,
     ):
+        """``mud_policy``: optional :class:`comm.mud.MudPolicy` gating
+        enrollment by RFC 8520 device identity (the CoLearn pattern)."""
         setup_lib.require_mean_aggregator(config, "the socket coordinator")
         self.config = config
         if config.fed.secure_agg and config.fed.secure_agg_neighbors and (
@@ -61,7 +64,7 @@ class FederatedCoordinator:
         self.round_timeout = round_timeout
         self.want_evaluator = want_evaluator
         self._broker = BrokerClient(broker_host, broker_port)
-        self._enroll = EnrollmentManager(self._broker)
+        self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy)
         params = setup_lib.init_global_params(config)
         self.server_state = strategies.init_server_state(params, config.fed)
         self.history: list[dict] = []

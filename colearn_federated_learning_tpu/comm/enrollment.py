@@ -40,6 +40,9 @@ class DeviceInfo:
     # (comm/keyexchange.py); empty when the worker runs without masking
     # or in shared_seed mode.
     pubkey: str = ""
+    # RFC 8520 MUD profile JSON (comm/mud.py) — the CoLearn identity the
+    # coordinator's MudPolicy gates enrollment on; empty = no profile.
+    mud: str = ""
 
     def to_fields(self) -> dict:
         return dataclasses.asdict(self)
@@ -59,6 +62,7 @@ def _parse_enroll(header: dict) -> DeviceInfo:
         num_examples=int(header.get("num_examples", 0)),
         dataset=str(header.get("dataset", "")),
         pubkey=str(header.get("pubkey", "")),
+        mud=str(header.get("mud", "")),
     )
 
 
@@ -129,12 +133,59 @@ class EnrollmentManager:
     devices enrolled; everyone else trains.
     """
 
-    def __init__(self, client: BrokerClient):
+    def __init__(self, client: BrokerClient, mud_policy=None):
+        """``mud_policy``: optional :class:`comm.mud.MudPolicy` — the
+        CoLearn enrollment gate.  Devices whose MUD profile fails the
+        policy (or is malformed) are REFUSED: recorded in ``rejected``
+        with the reason, never listed in ``devices()``."""
         self._client = client
         self._client.subscribe(ENROLL_TOPIC + "#")
         self._lock = threading.Lock()
         self._devices: dict[str, DeviceInfo] = {}
+        self._profiles: dict[str, object] = {}    # device_id -> MudProfile
         self._order: list[str] = []
+        self._mud_policy = mud_policy
+        self.rejected: dict[str, str] = {}        # device_id -> reason
+
+    def _admit(self, info: DeviceInfo) -> None:
+        from colearn_federated_learning_tpu.comm.mud import (
+            MudError,
+            MudProfile,
+        )
+
+        profile, parse_err = None, None
+        if info.mud:
+            try:
+                profile = MudProfile.from_json(info.mud)
+            except MudError as e:
+                parse_err = e
+        if self._mud_policy is not None:
+            try:
+                if parse_err is not None:
+                    raise parse_err
+                self._mud_policy.check(profile, info.device_id)
+            except MudError as e:
+                with self._lock:
+                    self.rejected[info.device_id] = str(e)
+                    # A previously admitted device that re-announces with
+                    # a now-rejected profile is withdrawn FROM THE
+                    # MANAGER: it no longer appears in devices()/
+                    # profile_of, and the elastic admission path will not
+                    # re-admit it.  A coordinator that already captured
+                    # the device in its trainers list keeps its own copy
+                    # — mid-run eviction is the coordinator's call (the
+                    # straggler/eviction machinery), not the manager's.
+                    if info.device_id in self._devices:
+                        del self._devices[info.device_id]
+                        self._order.remove(info.device_id)
+                        self._profiles.pop(info.device_id, None)
+                return
+        with self._lock:
+            self.rejected.pop(info.device_id, None)
+            if info.device_id not in self._devices:
+                self._order.append(info.device_id)
+            self._devices[info.device_id] = info
+            self._profiles[info.device_id] = profile
 
     def poll(self, duration: float) -> None:
         """Drain announcements for ``duration`` seconds."""
@@ -151,11 +202,13 @@ class EnrollmentManager:
                     or not str(header.get("topic", "")).startswith(
                         ENROLL_TOPIC)):
                 continue
-            info = _parse_enroll(header)
-            with self._lock:
-                if info.device_id not in self._devices:
-                    self._order.append(info.device_id)
-                self._devices[info.device_id] = info
+            self._admit(_parse_enroll(header))
+
+    def profile_of(self, device_id: str):
+        """The admitted device's parsed MudProfile (None when it enrolled
+        without one or no policy parses profiles)."""
+        with self._lock:
+            return self._profiles.get(device_id)
 
     def wait_for(self, n: int, timeout: float, poll_step: float = 0.2) -> None:
         """Poll until at least ``n`` devices enrolled (or raise)."""

@@ -51,7 +51,11 @@ class DeviceWorker:
         dataset: Optional[data_registry.Dataset] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        mud_profile: Optional[str] = None,
     ):
+        """``mud_profile``: RFC 8520 MUD JSON text announced on the
+        enrollment record (comm/mud.py) — the CoLearn device identity a
+        coordinator's MudPolicy gates admission on."""
         self.config = config
         self.client_id = int(client_id)
         c = config
@@ -124,6 +128,7 @@ class DeviceWorker:
         self._server = TensorServer(self._handle, host=host, port=port)
         self._broker: Optional[BrokerClient] = None
         self._broker_addr = (broker_host, broker_port)
+        self._mud_profile = mud_profile or ""
         self.role: Optional[str] = None
 
     # ------------------------------------------------------------------
@@ -154,6 +159,7 @@ class DeviceWorker:
                 dataset=self.config.data.dataset,
                 pubkey=(keyexchange.encode_public(self._dh_pub)
                         if self._dh_mode else ""),
+                mud=self._mud_profile,
             ))
         return self
 
@@ -411,9 +417,11 @@ class DeviceWorker:
 
 
 def run_worker_forever(config: ExperimentConfig, client_id: int,
-                       broker_host: str, broker_port: int) -> None:
+                       broker_host: str, broker_port: int,
+                       mud_profile: Optional[str] = None) -> None:
     """CLI entry: serve until the process is killed."""
-    worker = DeviceWorker(config, client_id, broker_host, broker_port).start()
+    worker = DeviceWorker(config, client_id, broker_host, broker_port,
+                          mud_profile=mud_profile).start()
     try:
         worker.await_role(timeout=3600.0)
         threading.Event().wait()      # serve forever

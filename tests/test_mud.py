@@ -1,0 +1,135 @@
+"""MUD (RFC 8520) device profiles + enrollment gating (comm/mud.py).
+
+CoLearn's defining idea is MUD-identity-gated federated learning
+(SURVEY.md §0); these tests cover the profile parser, the coordinator
+policy, per-type grouping, and the gate working end to end through a
+real broker federation.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.comm import mud
+from colearn_federated_learning_tpu.comm.broker import MessageBroker
+from colearn_federated_learning_tpu.comm.coordinator import (
+    FederatedCoordinator,
+)
+from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+from tests.test_comm import _config
+
+
+def _profile(device_type="camera", supported=True, url="https://m.example/p"):
+    return json.dumps({"ietf-mud:mud": {
+        "mud-version": 1,
+        "mud-url": url,
+        "is-supported": supported,
+        "systeminfo": "test device",
+        "mfg-name": "acme",
+        "model-name": "cam-3",
+        "colearn:device-type": device_type,
+        "cache-validity": 24,
+    }})
+
+
+def test_profile_parse_roundtrip_and_errors():
+    p = mud.MudProfile.from_json(_profile())
+    assert p.device_type == "camera" and p.mfg_name == "acme"
+    assert p.is_supported and p.mud_url.startswith("https://")
+    p2 = mud.MudProfile.from_json(p.to_json())
+    assert p2 == p
+
+    with pytest.raises(mud.MudError, match="JSON"):
+        mud.MudProfile.from_json("{not json")
+    with pytest.raises(mud.MudError, match="container"):
+        mud.MudProfile.from_json(json.dumps({"wrong": {}}))
+    with pytest.raises(mud.MudError, match="https"):
+        mud.MudProfile.from_json(_profile(url="http://insecure.example"))
+    with pytest.raises(mud.MudError, match="mud-version"):
+        mud.MudProfile.from_json(json.dumps({"ietf-mud:mud": {
+            "mud-version": 2, "mud-url": "https://x.example"}}))
+
+
+def test_malformed_field_is_mud_error_not_crash():
+    # Wrong-typed leaves must raise MudError (the enrollment loop's
+    # handler), never a bare ValueError that would crash the coordinator.
+    bad = json.dumps({"ietf-mud:mud": {
+        "mud-version": 1, "mud-url": "https://m.example/x",
+        "cache-validity": "48h"}})
+    with pytest.raises(mud.MudError, match="malformed MUD field"):
+        mud.MudProfile.from_json(bad)
+
+
+def test_allowlist_implies_profile_required():
+    # Omitting the profile must NOT bypass a type allowlist.
+    policy = mud.MudPolicy(allowed_types=("camera",))
+    with pytest.raises(mud.MudError, match="requires a MUD"):
+        policy.check(None)
+
+
+def test_policy_gates():
+    cam = mud.MudProfile.from_json(_profile("camera"))
+    old = mud.MudProfile.from_json(_profile("camera", supported=False))
+    bulb = mud.MudProfile.from_json(_profile("bulb"))
+
+    permissive = mud.MudPolicy()
+    permissive.check(None)                      # no profile is fine
+    permissive.check(cam)
+    with pytest.raises(mud.MudError, match="unsupported"):
+        permissive.check(old)                   # default require_supported
+
+    strict = mud.MudPolicy(require_profile=True,
+                           allowed_types=("camera",))
+    strict.check(cam)
+    with pytest.raises(mud.MudError, match="requires a MUD"):
+        strict.check(None)
+    with pytest.raises(mud.MudError, match="not in the allowed"):
+        strict.check(bulb)
+
+
+def test_group_by_device_type():
+    infos = [("a", mud.MudProfile.from_json(_profile("camera"))),
+             ("b", mud.MudProfile.from_json(_profile("bulb"))),
+             ("c", mud.MudProfile.from_json(_profile("camera"))),
+             ("d", None)]
+    groups = mud.group_by_device_type(infos)
+    assert sorted(groups["camera"]) == ["a", "c"]
+    assert groups["bulb"] == ["b"] and groups[""] == ["d"]
+
+
+def test_enrollment_gate_end_to_end():
+    # 2 cameras + 1 bulb + 1 profile-less device announce; a camera-only
+    # policy must federate EXACTLY the cameras, record the rejections,
+    # and the round must complete with the admitted cohort.
+    cfg = _config(num_clients=4)
+    policy = mud.MudPolicy(require_profile=True, allowed_types=("camera",))
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, 0, broker.host, broker.port,
+                         mud_profile=_profile("camera")).start(),
+            DeviceWorker(cfg, 1, broker.host, broker.port,
+                         mud_profile=_profile("camera")).start(),
+            DeviceWorker(cfg, 2, broker.host, broker.port,
+                         mud_profile=_profile("bulb")).start(),
+            DeviceWorker(cfg, 3, broker.host, broker.port).start(),
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=30.0,
+                                         want_evaluator=False,
+                                         mud_policy=policy)
+            coord.enroll(min_devices=2, timeout=20.0)
+            admitted = {d.device_id for d in coord.trainers}
+            assert admitted == {"0", "1"}
+            rejected = coord._enroll.rejected
+            assert "not in the allowed" in rejected["2"]
+            assert "requires a MUD" in rejected["3"]
+            # Admitted profiles are queryable (per-type topologies).
+            assert coord._enroll.profile_of("0").device_type == "camera"
+            rec = coord.run_round()
+            assert rec["completed"] == 2
+            coord.close()
+        finally:
+            for w in workers:
+                w.stop()
