@@ -14,6 +14,9 @@ keeps that two-plane architecture with zero external dependencies:
 - ``worker``:     device process — local shard + jit local trainer.
 - ``coordinator``: round loop over enrolled devices with per-round
   timeouts (straggler drop), server strategies, evaluator scoring.
+- ``mud``:        RFC 8520 device profiles + the enrollment gate
+  (CoLearn's MUD-identity pattern).
+- ``keyexchange``: DH pair keys for wire-plane secure aggregation.
 
 On-device simulation (fed/engine.py) is the fast path; this package is the
 cross-silo path where participants are separate processes/hosts.  Both use
@@ -24,5 +27,9 @@ the same config, trainer construction (fed/setup.py) and wire payloads
 from colearn_federated_learning_tpu.comm.broker import MessageBroker  # noqa: F401
 from colearn_federated_learning_tpu.comm.coordinator import (  # noqa: F401
     FederatedCoordinator,
+)
+from colearn_federated_learning_tpu.comm.mud import (  # noqa: F401
+    MudPolicy,
+    MudProfile,
 )
 from colearn_federated_learning_tpu.comm.worker import DeviceWorker  # noqa: F401
