@@ -317,6 +317,39 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
                 t for t in (args.mud_allowed_types or "").split(",") if t
             ),
         )
+    if args.per_type:
+        from colearn_federated_learning_tpu.comm.per_type import (
+            PerTypeFederation,
+        )
+
+        fed = PerTypeFederation(
+            config, args.broker_host, args.broker_port,
+            round_timeout=args.round_timeout, mud_policy=mud_policy,
+            min_devices_per_type=args.min_per_type,
+        )
+
+        def log_line(t, rec):
+            # One atomic write per record: federation threads log
+            # concurrently and print()'s separate newline write could
+            # interleave lines mid-JSON.
+            sys.stderr.write(json.dumps({"type": t, **rec}) + "\n")
+
+        try:
+            hists = fed.run(
+                min_devices=args.min_devices,
+                enroll_timeout=args.enroll_timeout,
+                want_evaluator=not args.no_evaluator,
+                log_fn=log_line,
+            )
+            print(json.dumps({
+                "types": {t: (h[-1] if h else None)
+                          for t, h in hists.items()},
+                "skipped": fed.skipped,
+                "errors": fed.errors,
+            }))
+        finally:
+            fed.close()
+        return 0 if hists and not fed.errors else 1
     if args.async_buffer:
         from colearn_federated_learning_tpu.comm.async_coordinator import (
             AsyncFederatedCoordinator,
@@ -465,6 +498,13 @@ def main(argv: list[str] | None = None) -> int:
     p_coord.add_argument("--per-client-eval", action="store_true",
                          help="report each trainer's own-shard accuracy "
                               "after training (worker self_eval op)")
+    p_coord.add_argument("--per-type", action="store_true",
+                         help="one federation per MUD device type (the "
+                              "CoLearn topology; comm/per_type.py) — "
+                              "each type trains its own global model")
+    p_coord.add_argument("--min-per-type", type=int, default=2,
+                         help="smallest device class that gets its own "
+                              "federation under --per-type")
     p_coord.add_argument("--mud-require-profile", action="store_true",
                          help="refuse devices that enroll without an RFC "
                               "8520 MUD profile (comm/mud.py)")

@@ -45,9 +45,13 @@ class FederatedCoordinator:
         round_timeout: float = 60.0,
         want_evaluator: bool = True,
         mud_policy=None,
+        device_type: Optional[str] = None,
     ):
         """``mud_policy``: optional :class:`comm.mud.MudPolicy` gating
-        enrollment by RFC 8520 device identity (the CoLearn pattern)."""
+        enrollment by RFC 8520 device identity (the CoLearn pattern).
+        ``device_type``: federate ONLY devices of this MUD type — the
+        per-type topology (comm/per_type.py runs one coordinator per
+        discovered type over a shared broker)."""
         setup_lib.require_mean_aggregator(config, "the socket coordinator")
         self.config = config
         if config.fed.secure_agg and config.fed.secure_agg_neighbors and (
@@ -64,7 +68,8 @@ class FederatedCoordinator:
         self.round_timeout = round_timeout
         self.want_evaluator = want_evaluator
         self._broker = BrokerClient(broker_host, broker_port)
-        self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy)
+        self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy,
+                                         device_type=device_type)
         params = setup_lib.init_global_params(config)
         self.server_state = strategies.init_server_state(params, config.fed)
         self.history: list[dict] = []

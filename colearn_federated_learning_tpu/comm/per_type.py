@@ -1,0 +1,133 @@
+"""Per-device-type federations — the CoLearn deployment topology.
+
+The CoLearn system's point (SURVEY.md §0) is that MUD identity decides
+WHICH federation a device joins: cameras train the camera anomaly model,
+bulbs the bulb model — one global model across heterogeneous device
+classes would smear their distinct "normal" traffic together.  This
+module runs that topology over the in-tree planes:
+
+1. discover device types from the retained enrollment records (every
+   worker announces its RFC 8520 profile, comm/mud.py);
+2. one :class:`~.coordinator.FederatedCoordinator` per type, each
+   filtering enrollment to ITS type (sibling devices are not-mine, not
+   rejections), each training its OWN global model;
+3. federations run in THREADS over the shared broker — a slow device
+   class does not stall the others (each coordinator already owns its
+   round deadline).
+
+``colearn coordinate --per-type`` is the CLI entry.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from colearn_federated_learning_tpu.comm.broker import BrokerClient
+from colearn_federated_learning_tpu.comm.coordinator import (
+    FederatedCoordinator,
+)
+from colearn_federated_learning_tpu.comm.enrollment import EnrollmentManager
+from colearn_federated_learning_tpu.comm.mud import group_by_device_type
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+def discover_types(broker_host: str, broker_port: int,
+                   min_devices: int, timeout: float,
+                   mud_policy=None) -> dict[str, list]:
+    """``{device_type: [DeviceInfo, ...]}`` from the retained enrollment
+    records, waiting until at least ``min_devices`` admitted devices are
+    visible.  Profile-less devices group under ``""`` (callers decide
+    whether an untyped federation makes sense)."""
+    client = BrokerClient(broker_host, broker_port)
+    try:
+        enroll = EnrollmentManager(client, mud_policy=mud_policy)
+        enroll.wait_for(min_devices, timeout)
+        pairs = [(d, enroll.profile_of(d.device_id))
+                 for d in enroll.devices()]
+        return group_by_device_type(pairs)
+    finally:
+        client.close()
+
+
+class PerTypeFederation:
+    """One federation per discovered MUD device type (see module doc)."""
+
+    def __init__(
+        self,
+        config: ExperimentConfig,
+        broker_host: str,
+        broker_port: int,
+        round_timeout: float = 60.0,
+        mud_policy=None,
+        min_devices_per_type: int = 2,
+    ):
+        self.config = config
+        self.broker = (broker_host, broker_port)
+        self.round_timeout = round_timeout
+        self.mud_policy = mud_policy
+        self.min_per_type = min_devices_per_type
+        self.coordinators: dict[str, FederatedCoordinator] = {}
+        self.skipped: dict[str, int] = {}     # type -> too-few device count
+        self.histories: dict[str, list] = {}
+        self.errors: dict[str, str] = {}
+
+    def run(self, min_devices: int, enroll_timeout: float = 60.0,
+            rounds: Optional[int] = None, want_evaluator: bool = False,
+            log_fn=None) -> dict[str, list]:
+        """Discover types, then train every type's federation to
+        completion (threads; shared broker).  Returns per-type round
+        histories; types with fewer than ``min_devices_per_type``
+        devices are skipped and recorded in ``skipped``."""
+        import dataclasses
+
+        groups = discover_types(*self.broker, min_devices=min_devices,
+                                timeout=enroll_timeout,
+                                mud_policy=self.mud_policy)
+        group_sizes: dict[str, int] = {}
+        for dtype, devs in sorted(groups.items()):
+            if not dtype or len(devs) < self.min_per_type:
+                self.skipped[dtype] = len(devs)
+                continue
+            group_sizes[dtype] = len(devs)
+            cfg = self.config.replace(run=dataclasses.replace(
+                self.config.run,
+                name=f"{self.config.run.name}_{dtype}",
+            ))
+            self.coordinators[dtype] = FederatedCoordinator(
+                cfg, *self.broker, round_timeout=self.round_timeout,
+                want_evaluator=want_evaluator, mud_policy=self.mud_policy,
+                device_type=dtype,
+            )
+
+        def train(dtype: str, coord: FederatedCoordinator) -> None:
+            try:
+                # Wait for the FULL discovered cohort of this type, not
+                # just the minimum: a replay that is still in flight must
+                # not strand the tail devices role-less while their data
+                # silently never contributes.
+                coord.enroll(min_devices=group_sizes[dtype],
+                             timeout=enroll_timeout)
+                self.histories[dtype] = coord.fit(
+                    rounds=rounds,
+                    log_fn=(lambda rec, t=dtype: log_fn(t, rec))
+                    if log_fn else None,
+                )
+            except Exception as e:  # noqa: BLE001 — per-type isolation:
+                # one failing device class must not kill the others.
+                self.errors[dtype] = f"{type(e).__name__}: {e}"
+
+        threads = [
+            threading.Thread(target=train, args=(t, c), daemon=True,
+                             name=f"federate-{t}")
+            for t, c in self.coordinators.items()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.histories
+
+    def close(self) -> None:
+        for coord in self.coordinators.values():
+            coord.close()

@@ -98,6 +98,54 @@ def test_group_by_device_type():
     assert groups["bulb"] == ["b"] and groups[""] == ["d"]
 
 
+def test_per_type_federations():
+    # The CoLearn topology: 2 cameras + 2 bulbs -> TWO federations over
+    # one broker, each training its own global model on exactly its
+    # type's devices; a lone thermostat is skipped (below min size).
+    import dataclasses
+
+    import jax
+
+    from colearn_federated_learning_tpu.comm.per_type import (
+        PerTypeFederation,
+    )
+
+    cfg = _config(num_clients=5)
+    cfg = cfg.replace(fed=dataclasses.replace(cfg.fed, rounds=2))
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port,
+                         mud_profile=_profile(t)).start()
+            for i, t in ((0, "camera"), (1, "camera"),
+                         (2, "bulb"), (3, "bulb"), (4, "thermostat"))
+        ]
+        try:
+            fed = PerTypeFederation(cfg, broker.host, broker.port,
+                                    round_timeout=30.0,
+                                    min_devices_per_type=2)
+            hists = fed.run(min_devices=5, enroll_timeout=20.0)
+            assert not fed.errors, fed.errors
+            assert set(hists) == {"camera", "bulb"}
+            assert fed.skipped == {"thermostat": 1}
+            for dtype in ("camera", "bulb"):
+                coord = fed.coordinators[dtype]
+                ids = {d.device_id for d in coord.trainers}
+                want = {"0", "1"} if dtype == "camera" else {"2", "3"}
+                assert ids == want, (dtype, ids)
+                assert all(r["completed"] == 2 for r in hists[dtype])
+            # The two type models genuinely diverged (trained on
+            # different cohorts from the same init).
+            flat = lambda c: np.concatenate([  # noqa: E731
+                np.ravel(np.asarray(a))
+                for a in jax.tree.leaves(c.server_state.params)])
+            assert not np.allclose(flat(fed.coordinators["camera"]),
+                                   flat(fed.coordinators["bulb"]))
+        finally:
+            fed.close()
+            for w in workers:
+                w.stop()
+
+
 def test_enrollment_gate_end_to_end():
     # 2 cameras + 1 bulb + 1 profile-less device announce; a camera-only
     # policy must federate EXACTLY the cameras, record the rejections,
