@@ -219,10 +219,11 @@ def cmd_train(args: argparse.Namespace) -> int:
 
         learner.fit(log_fn=log_fn)
         def dump_report(rep):
-            print(json.dumps({
-                k: (v.tolist() if hasattr(v, "tolist") else v)
-                for k, v in rep.items()
-            }), file=sys.stderr)
+            from colearn_federated_learning_tpu.fed.evaluation import (
+                sanitize_report,
+            )
+
+            print(json.dumps(sanitize_report(rep)), file=sys.stderr)
 
         if args.per_client_eval:
             dump_report(learner.evaluate_per_client())
@@ -265,7 +266,8 @@ def cmd_eval(args: argparse.Namespace) -> int:
     from colearn_federated_learning_tpu.fed import offline
 
     config = config_from_args(args)
-    print(json.dumps(offline.evaluate_global(config, args.global_model)))
+    print(json.dumps(offline.evaluate_global(
+        config, args.global_model, detection=args.detection_eval)))
     return 0
 
 
@@ -459,6 +461,9 @@ def main(argv: list[str] | None = None) -> int:
     p_eval = sub.add_parser("eval", help="evaluate a global model file")
     _add_override_flags(p_eval)
     p_eval.add_argument("--global-model", required=True)
+    p_eval.add_argument("--detection-eval", action="store_true",
+                        help="add the anomaly-detection report (per-class "
+                             "P/R/F1, alarm detection/false-alarm rates)")
     p_eval.set_defaults(fn=cmd_eval)
 
     sub.add_parser("configs", help="list experiment configs").set_defaults(

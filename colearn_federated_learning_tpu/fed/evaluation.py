@@ -124,6 +124,14 @@ def detection_report(conf: np.ndarray, benign_class: int = 0) -> dict:
     }
 
 
+def sanitize_report(rep: dict) -> dict:
+    """JSON-ready copy of a metrics report (numpy arrays -> lists) — the
+    ONE serialization rule shared by every report printer (CLI stderr
+    dumps, file-plane eval records)."""
+    return {k: (v.tolist() if hasattr(v, "tolist") else v)
+            for k, v in rep.items()}
+
+
 def summarize_per_client(losses, accs, counts) -> dict:
     """Example-weighted aggregates + accuracy spread over per-client
     scores — ONE definition shared by the engine's vmapped per-client

@@ -142,12 +142,20 @@ def aggregate_updates(
 
 
 def evaluate_global(config: ExperimentConfig, global_path: str,
-                    dataset: Optional[data_registry.Dataset] = None) -> dict:
+                    dataset: Optional[data_registry.Dataset] = None,
+                    detection: bool = False) -> dict:
     """Evaluator role (SURVEY.md §3d): score a global-model file.
 
     Builds only the model and the eval scan — no partitioning, no trainer,
-    no client data placement."""
-    from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
+    no client data placement.  ``detection=True`` adds the anomaly-
+    detection view (per-class P/R/F1, alarm detection/false-alarm rates;
+    fed/evaluation.detection_report, class 0 = benign)."""
+    from colearn_federated_learning_tpu.fed.evaluation import (
+        detection_report,
+        make_confusion_eval_fn,
+        make_eval_fn,
+        sanitize_report,
+    )
 
     params, meta = load_pytree_npz(global_path)
     ds = dataset or data_registry.get_dataset(config.data.dataset,
@@ -155,8 +163,19 @@ def evaluate_global(config: ExperimentConfig, global_path: str,
     model = model_registry.build_model(
         setup_lib.local_model_config(config.model)
     )
+    params = jax.tree.map(jnp.asarray, params)
     eval_fn = make_eval_fn(model.apply, ds.x_test, ds.y_test,
                            batch=max(config.fed.batch_size, 64))
-    loss, acc = eval_fn(jax.tree.map(jnp.asarray, params))
-    return {"round": int(meta.get("round", 0)), "eval_loss": float(loss),
-            "eval_acc": float(acc)}
+    loss, acc = eval_fn(params)
+    out = {"round": int(meta.get("round", 0)), "eval_loss": float(loss),
+           "eval_acc": float(acc)}
+    if detection:
+        conf_fn = make_confusion_eval_fn(
+            model.apply, ds.x_test, ds.y_test,
+            batch=max(config.fed.batch_size, 64),
+            num_classes=config.model.num_classes,
+        )
+        rep = detection_report(np.asarray(conf_fn(params)))
+        rep.pop("accuracy", None)       # eval_acc above is canonical
+        out.update(sanitize_report(rep))
+    return out

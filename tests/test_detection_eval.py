@@ -72,6 +72,37 @@ def test_confusion_eval_fn_counts_every_example():
     assert conf[:, [0, 2]].sum() == 0
 
 
+def test_offline_eval_detection_report(tmp_path):
+    # File-plane parity: `colearn eval --detection-eval` on a global-model
+    # file reports the same detection view the engine produces.
+    import dataclasses
+
+    from colearn_federated_learning_tpu.fed import offline
+    from colearn_federated_learning_tpu.utils.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        RunConfig,
+    )
+
+    cfg = ExperimentConfig(
+        data=DataConfig(dataset="iot_traffic_tiny", num_clients=4,
+                        partition="iid", max_examples_per_client=32),
+        model=ModelConfig(name="tcn", num_classes=8, width=16, depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=2, cohort_size=0,
+                      local_steps=2, batch_size=16, lr=0.05, momentum=0.9),
+        run=RunConfig(name="offline_detect"),
+    )
+    g0 = str(tmp_path / "g0.npz")
+    offline.init_global_model(cfg, g0)
+    rec = offline.evaluate_global(cfg, g0, detection=True)
+    assert {"detection_rate", "false_alarm_rate", "macro_f1",
+            "per_class_recall"} <= set(rec)
+    assert len(rec["per_class_recall"]) == 8
+    assert sum(rec["support"]) == 400           # iot_traffic_tiny n_test
+
+
 def test_engine_detection_eval_on_iot_config():
     from colearn_federated_learning_tpu.fed.engine import FederatedLearner
     from colearn_federated_learning_tpu.utils.config import (
