@@ -67,7 +67,8 @@ def scaled_variants():
         fed=dataclasses.replace(c.fed, rounds=20, lr=1e-4),
     )
     out["agnews_bert_fedavg"] = (
-        c, "BERT scaled 768x12 -> 256x4 (single-chip budget); lr 1e-4")
+        c, "BERT scaled 768x12 -> 256x4 (single-chip budget); adam 1e-4 "
+           "+ the config's warmup_cosine schedule (round 4)")
 
     # Not a BASELINE config — the MoE family is a rebuild superset; its
     # curve documents that the expert-parallel path LEARNS, not just runs.

@@ -324,24 +324,25 @@ def test_coordinator_checkpoint_kill_and_resume(tmp_path):
 
 
 # ------------------------------------------- wire secure aggregation ----
-def test_socket_secure_agg_masks_cancel():
-    # Full participation: the coordinator's aggregate over MASKED wire
-    # updates must match a parallel unmasked federation (masks cancel in
-    # the sum; uniform weighting both sides since secure-agg forces it).
+def _masked_vs_plain(num_clients: int, neighbors: int):
+    """(masked, plain) flattened global params after 2 rounds of full
+    participation — shared by the complete-graph and random-ring masking
+    tests (the aggregate must match an unmasked federation either way)."""
     import jax
 
     def run(secure):
-        cfg = _config(num_clients=3, secure_agg=secure)
+        cfg = _config(num_clients=num_clients, secure_agg=secure,
+                      secure_agg_neighbors=neighbors if secure else 0)
         with MessageBroker() as broker:
             workers = [
                 DeviceWorker(cfg, i, broker.host, broker.port).start()
-                for i in range(3)
+                for i in range(num_clients)
             ]
             try:
                 coord = FederatedCoordinator(cfg, broker.host, broker.port,
                                              round_timeout=60.0,
                                              want_evaluator=False)
-                coord.enroll(min_devices=3, timeout=20.0)
+                coord.enroll(min_devices=num_clients, timeout=20.0)
                 coord.fit(rounds=2)
                 return np.concatenate([
                     np.ravel(np.asarray(a))
@@ -351,7 +352,15 @@ def test_socket_secure_agg_masks_cancel():
                 for w in workers:
                     w.stop()
 
-    masked, plain = run(True), run(False)
+    return run(True), run(False)
+
+
+def test_socket_secure_agg_masks_cancel():
+    # Full participation, complete pairing graph: the coordinator's
+    # aggregate over MASKED wire updates must match a parallel unmasked
+    # federation (masks cancel in the sum; uniform weighting both sides
+    # since secure-agg forces it).
+    masked, plain = _masked_vs_plain(num_clients=3, neighbors=0)
     # Cancellation residual is float32-summation noise on ~1e-3 deltas.
     np.testing.assert_allclose(masked, plain, atol=2e-4)
 
@@ -439,6 +448,15 @@ def test_coordinator_view_cannot_unmask_dh():
         finally:
             for w in workers:
                 w.stop()
+
+
+def test_dh_ring_masking_cancels():
+    # DH pair keys compose with the k-regular random-RING pairing graph
+    # (secure_agg_neighbors=2): the ring permutation is public (derived
+    # from the shared seed), only the per-pair mask keys are DH secrets.
+    # 4 workers, full participation: aggregate must match plain.
+    masked, plain = _masked_vs_plain(num_clients=4, neighbors=2)
+    np.testing.assert_allclose(masked, plain, atol=2e-4)
 
 
 def test_dh_peer_restart_refreshes_pubkey():
