@@ -43,6 +43,7 @@ from colearn_federated_learning_tpu.models import registry as model_registry
 from colearn_federated_learning_tpu.privacy import dp as dp_lib
 from colearn_federated_learning_tpu.privacy import secure_agg as sa_lib
 from colearn_federated_learning_tpu.utils import prng, pytrees
+from colearn_federated_learning_tpu.utils import config as config_lib
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 
 
@@ -152,6 +153,7 @@ class FederatedLearner:
         self.config = config
         self.mesh = mesh
         c = config
+        config_lib.validate_experiment(c)
 
         # --- mesh axes ------------------------------------------------
         # 1-D mesh: clients only.  2-D (attn_impl="ring"): + an inner ``seq``

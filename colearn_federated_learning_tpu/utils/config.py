@@ -10,14 +10,46 @@ mirroring the five driver benchmark configs from BASELINE.json ``configs``.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Optional
+
+# Measured dense/flash crossover (PERF.md §1b): at seq 128 the Pallas flash
+# kernel LOSES to dense (1.55 vs 2.12 rounds/sec on the config-#4 BERT) —
+# tiling overhead only pays for itself once the O(L^2) score matrix stops
+# fitting in VMEM, around L≈1-2k on v5-lite.  Below this length the guard
+# warns; dense is both faster and numerically identical.
+FLASH_SEQ_CROSSOVER = 1024
+
+
+def validate_experiment(config: "ExperimentConfig") -> None:
+    """Cross-field sanity checks for perf footguns.
+
+    Warns rather than raises: every combination here EXECUTES correctly,
+    it is just measured-slower than the obvious alternative, and a user
+    sweeping configs must be able to override a heuristic.  Called by
+    ``FederatedLearner.__init__`` so every entry path (CLI, from_config,
+    direct construction) passes through it once."""
+    m = config.model
+    if m.attn_impl == "flash" and m.seq_len < FLASH_SEQ_CROSSOVER:
+        warnings.warn(
+            f"attn_impl='flash' at seq_len={m.seq_len}: dense attention is "
+            f"measured FASTER below seq_len~{FLASH_SEQ_CROSSOVER} (PERF.md "
+            "§1b: 2.12 vs 1.55 rounds/sec at L=128 on the config-#4 BERT); "
+            "use attn_impl='dense' unless you are measuring the kernel "
+            "itself",
+            # Attribute to validate_experiment's caller (engine __init__):
+            # the call depth from user code varies (direct construction vs
+            # from_config), so no fixed level reaches the user frame — the
+            # message itself carries the identifying config values instead.
+            stacklevel=2,
+        )
 
 
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
     dataset: str = "mnist"            # registry name (data/registry.py)
     num_clients: int = 10
-    partition: str = "iid"            # "iid" | "dirichlet"
+    partition: str = "iid"            # "iid" | "dirichlet" | "pathological"
     dirichlet_alpha: float = 0.5      # non-IID skew (BASELINE config #2)
     max_examples_per_client: int = 0  # 0 = derive from dataset size
 
