@@ -57,6 +57,43 @@ def dirichlet_partition(
     )
 
 
+def pathological_partition(
+    labels: np.ndarray,
+    num_clients: int,
+    shards_per_client: int = 2,
+    seed: int = 0,
+) -> list[np.ndarray]:
+    """McMahan et al. (2017) §3 "pathological non-IID" split: sort the
+    examples by label, cut them into ``num_clients * shards_per_client``
+    equal contiguous shards, and deal each client ``shards_per_client``
+    shards at random — so most clients see only ``shards_per_client``
+    distinct digits.  This is the partition behind the paper's Table 1
+    non-IID rows, which scripts/validate_literature.py reproduces as the
+    framework's literature anchor (SURVEY.md hard-part #5).
+
+    A stable mergesort keeps equal-label runs in index order, so the split
+    is deterministic given (labels, seed).
+    """
+    labels = np.asarray(labels)
+    n = len(labels)
+    n_shards = num_clients * shards_per_client
+    if n_shards > n:
+        raise ValueError(
+            f"need >= {n_shards} examples for {num_clients} clients x "
+            f"{shards_per_client} shards, have {n}"
+        )
+    order = np.argsort(labels, kind="stable")
+    shard_ids = np.random.default_rng(seed).permutation(n_shards)
+    bounds = np.linspace(0, n, n_shards + 1).astype(int)
+    return [
+        np.sort(np.concatenate([
+            order[bounds[s]:bounds[s + 1]]
+            for s in shard_ids[c * shards_per_client:(c + 1) * shards_per_client]
+        ]))
+        for c in range(num_clients)
+    ]
+
+
 def partition_counts(parts: list[np.ndarray]) -> np.ndarray:
     return np.array([len(p) for p in parts], dtype=np.int32)
 

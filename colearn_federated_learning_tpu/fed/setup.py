@@ -34,11 +34,26 @@ def local_model_config(model_cfg):
 def partition_for_config(
     config: ExperimentConfig, labels: np.ndarray
 ) -> list[np.ndarray]:
-    """Per-client index lists for ``config.data`` (iid | dirichlet)."""
+    """Per-client index lists for ``config.data``
+    (iid | dirichlet | pathological)."""
     c = config.data
     if c.partition == "dirichlet":
         return partition_lib.dirichlet_partition(
             labels, c.num_clients, c.dirichlet_alpha, seed=config.run.seed
+        )
+    if c.partition == "pathological":
+        # McMahan-style sort-and-deal 2-shard split (the literature-anchor
+        # protocol, scripts/validate_literature.py).
+        return partition_lib.pathological_partition(
+            labels, c.num_clients, seed=config.run.seed
+        )
+    if c.partition != "iid":
+        # A typo must not silently train on an IID split — for the
+        # literature protocol that would "validate" the non-IID anchor
+        # against the wrong partition with plausible-looking numbers.
+        raise ValueError(
+            f"unknown data.partition {c.partition!r}; "
+            "use iid | dirichlet | pathological"
         )
     return partition_lib.iid_partition(
         len(labels), c.num_clients, seed=config.run.seed

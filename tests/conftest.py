@@ -53,6 +53,15 @@ try:
 except Exception:
     pass
 
+import sys
+
+# Repo root on sys.path regardless of how pytest was launched: test modules
+# import both `tests.*` helpers and `scripts.*` protocol builders, and
+# pytest's prepend import mode only adds tests/ itself.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,110 @@
+"""Literature-anchored validation (SURVEY.md hard-part #5, VERDICT r4 #6).
+
+Two layers:
+
+1. The McMahan pathological-non-IID partitioner is a pure function over
+   labels — its structural properties (2 digits per client, equal sizes,
+   exact cover) are pinned here with synthetic labels, no data needed.
+2. The accuracy anchors run ONLY when real MNIST is staged on disk
+   (scripts/fetch_data.py -> $COLEARN_DATA_DIR/mnist.npz): a shortened
+   version of scripts/validate_literature.py's protocol — the paper's 2NN
+   at C=0.1, B=10, E=1 must clear 90% test accuracy within 30 IID rounds
+   (the paper's Figure 2 curve is well above that by then), and the
+   pathological split must trail the IID split at equal rounds.  The full
+   rounds-to-97% protocol (Table 1: ~87 IID / ~664 non-IID) lives in the
+   script; this is the CI-sized slice.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.data import registry as data_registry
+from colearn_federated_learning_tpu.data.partition import (
+    label_distribution,
+    pathological_partition,
+)
+
+
+def _labels(n=6000, n_classes=10, seed=0):
+    return np.random.default_rng(seed).integers(0, n_classes, n)
+
+
+def test_pathological_partition_structure():
+    labels = _labels()
+    parts = pathological_partition(labels, num_clients=100, seed=0)
+    # Exact cover: every index appears exactly once across clients.
+    all_idx = np.concatenate(parts)
+    assert len(all_idx) == len(labels)
+    assert len(np.unique(all_idx)) == len(labels)
+    # Equal shard deal: sizes match the 2-shard allotment within rounding.
+    sizes = np.array([len(p) for p in parts])
+    assert sizes.min() >= len(labels) // 100 - 2
+    assert sizes.max() <= len(labels) // 100 + 2
+    # Pathological skew: almost every client sees at most ~2-3 distinct
+    # labels (a shard can straddle one label boundary).
+    dist = label_distribution(labels, parts, 10)
+    classes_per_client = (dist > 0).sum(axis=1)
+    assert np.median(classes_per_client) <= 3
+    assert classes_per_client.max() <= 4
+
+
+def test_pathological_partition_deterministic():
+    labels = _labels()
+    a = pathological_partition(labels, 50, seed=7)
+    b = pathological_partition(labels, 50, seed=7)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    c = pathological_partition(labels, 50, seed=8)
+    assert any(len(x) != len(y) or (x != y).any() for x, y in zip(a, c))
+
+
+def test_pathological_partition_too_few_examples():
+    with pytest.raises(ValueError, match="need >="):
+        pathological_partition(_labels(n=50), num_clients=100)
+
+
+def _real_mnist():
+    ds = data_registry.get_dataset("mnist", seed=0)
+    return ds if ds.source == "disk" else None
+
+
+needs_mnist = pytest.mark.skipif(
+    not os.path.exists(os.path.join(
+        os.environ.get("COLEARN_DATA_DIR", "/nonexistent"), "mnist.npz")),
+    reason="real MNIST not staged (scripts/fetch_data.py + COLEARN_DATA_DIR)",
+)
+
+
+@needs_mnist
+@pytest.mark.slow
+def test_mcmahan_2nn_iid_anchor():
+    from scripts.validate_literature import mcmahan_2nn_config
+    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+
+    ds = _real_mnist()
+    assert ds is not None
+    cfg = mcmahan_2nn_config("iid", rounds=30, lr=0.1)
+    learner = FederatedLearner.from_config(cfg, dataset=ds)
+    learner.fit(rounds=30)
+    _, acc = learner.evaluate()
+    assert float(acc) >= 0.90, f"IID 2NN at round 30: acc={float(acc):.4f}"
+
+
+@needs_mnist
+@pytest.mark.slow
+def test_mcmahan_2nn_noniid_trails_iid():
+    from scripts.validate_literature import mcmahan_2nn_config
+    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+
+    ds = _real_mnist()
+    assert ds is not None
+    accs = {}
+    for part in ("iid", "pathological"):
+        cfg = mcmahan_2nn_config(part, rounds=20, lr=0.1)
+        learner = FederatedLearner.from_config(cfg, dataset=ds)
+        learner.fit(rounds=20)
+        _, acc = learner.evaluate()
+        accs[part] = float(acc)
+    assert accs["pathological"] < accs["iid"], accs

@@ -63,3 +63,21 @@ def test_pad_clients_to_multiple_ghost_clients():
     shards = pad_clients_to_multiple(pack_client_shards(x, y, parts), 8)
     assert shards.num_clients == 8
     assert list(shards.counts[3:]) == [0] * 5  # ghosts have zero weight
+
+
+def test_unknown_partition_name_raises():
+    # A typo must not silently fall through to IID (the literature anchor
+    # would then "validate" non-IID claims against the wrong split).
+    import dataclasses
+
+    import pytest
+
+    from colearn_federated_learning_tpu.fed import setup as setup_lib
+    from colearn_federated_learning_tpu.utils.config import (
+        ExperimentConfig,
+        DataConfig,
+    )
+
+    cfg = ExperimentConfig(data=DataConfig(partition="pathologcal"))
+    with pytest.raises(ValueError, match="unknown data.partition"):
+        setup_lib.partition_for_config(cfg, np.zeros(100, np.int32))
