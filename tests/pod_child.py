@@ -1,0 +1,68 @@
+"""Child process for tests/test_pod_shape.py: a cohort-N federated round
+over a D-device virtual CPU mesh (D beyond the conftest's 8).
+
+Usage: python pod_child.py <n_devices> <cohort> <num_clients>
+
+Prints ``POD <json>`` with the round metrics the parent asserts on.  Runs
+in its own process because the virtual device count is fixed at backend
+init — the test suite's 8-device platform can't grow to 16+ in-process.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    n_devices, cohort, num_clients = (int(a) for a in sys.argv[1:4])
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices} "
+        "--xla_backend_optimization_level=0"
+    )
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+
+    from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+    from colearn_federated_learning_tpu.utils.config import (
+        DataConfig,
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        RunConfig,
+    )
+
+    devices = jax.devices()
+    assert len(devices) >= n_devices, devices
+    mesh = Mesh(np.array(devices[:n_devices]), ("clients",))
+    config = ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=num_clients,
+                        partition="dirichlet", dirichlet_alpha=0.5,
+                        max_examples_per_client=16),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=16, depth=1),
+        fed=FedConfig(strategy="fedavg", rounds=2, cohort_size=cohort,
+                      local_steps=1, batch_size=4, lr=0.1, momentum=0.9),
+        run=RunConfig(name="pod_child"),
+    )
+    learner = FederatedLearner(config, mesh=mesh)
+    hist = learner.fit(rounds=2)
+    out = {
+        "n_devices": n_devices,
+        "num_clients": learner.num_clients,
+        "cohort_per_device": learner.cohort_per_device,
+        "completed": [int(r["completed"]) for r in hist],
+        "train_loss": [float(r["train_loss"]) for r in hist],
+        "total_weight": [float(r["total_weight"]) for r in hist],
+    }
+    print("POD", json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
