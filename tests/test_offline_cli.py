@@ -126,7 +126,7 @@ def test_cli_bench_parses_forwarded_args(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_save_last_tpu", lambda out: None)
     monkeypatch.setattr(
         bench, "run_tpu_native",
-        lambda rounds, warmup, workload=None: {
+        lambda rounds, warmup, workload=None, min_time_s=0.0: {
             "rounds_per_sec": float(rounds),
             "client_samples_per_sec_per_chip": 1.0,
             "n_devices": 1,
@@ -154,7 +154,7 @@ def test_bench_cpu_fallback_embeds_last_tpu(monkeypatch, capsys, tmp_path):
     monkeypatch.setattr(bench, "force_cpu", lambda: None)
     monkeypatch.setattr(
         bench, "run_tpu_native",
-        lambda rounds, warmup, workload=None: {
+        lambda rounds, warmup, workload=None, min_time_s=0.0: {
             "rounds_per_sec": 5.0,
             "client_samples_per_sec_per_chip": 1.0,
             "n_devices": 1,
