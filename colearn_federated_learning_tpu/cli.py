@@ -62,7 +62,8 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "(fed/hierarchical.py)")
     p.add_argument("--edge-sync-period", type=int, default=None)
     p.add_argument("--dataset", default=None)
-    p.add_argument("--partition", default=None, choices=["iid", "dirichlet"])
+    p.add_argument("--partition", default=None,
+                   choices=["iid", "dirichlet", "pathological"])
     p.add_argument("--dirichlet-alpha", type=float, default=None)
     p.add_argument("--dp-clip", type=float, default=None)
     p.add_argument("--dp-noise-multiplier", type=float, default=None)

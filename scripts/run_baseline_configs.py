@@ -33,6 +33,13 @@ if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 
+def _vit_tiny7(model_cfg):
+    """The ONE definition of the tiny/7 stand-in for ViT-B/16 (both the
+    340-client curve and the spec-N bookkeeping variant scale with it)."""
+    return dataclasses.replace(model_cfg, width=192, depth=4, num_heads=3,
+                               patch_size=7)
+
+
 def scaled_variants():
     """name -> (scaled ExperimentConfig, note)."""
     from colearn_federated_learning_tpu.utils.config import get_config
@@ -95,8 +102,7 @@ def scaled_variants():
 
     c = get_config("femnist_vit_cross_silo")
     c = c.replace(
-        model=dataclasses.replace(c.model, width=192, depth=4, num_heads=3,
-                                  patch_size=7),
+        model=_vit_tiny7(c.model),
         data=dataclasses.replace(c.data, num_clients=340,
                                  max_examples_per_client=64),
         fed=dataclasses.replace(c.fed, rounds=20, cohort_size=32),
@@ -125,6 +131,20 @@ def scaled_variants():
     out["femnist_vit_full3400"] = (
         c, "FULL ViT-B/16 768x12, ALL 3400 resident clients, cohort 256 "
            "(config #5 at stated N; 64 ex/client cap)")
+
+    # Spec-N bookkeeping proof that also fits a CPU session: all 3,400
+    # resident clients and the cohort-256 round structure with the model
+    # scaled down — what it demonstrates is sampling / shard packing /
+    # per-client state at config #5's stated N, not model quality.
+    c = get_config("femnist_vit_cross_silo")
+    c = c.replace(
+        model=_vit_tiny7(c.model),
+        data=dataclasses.replace(c.data, max_examples_per_client=64),
+        fed=dataclasses.replace(c.fed, rounds=10),
+    )
+    out["femnist_vit3400_scaled"] = (
+        c, "ALL 3400 resident clients, cohort 256 (spec N); ViT scaled "
+           "B/16 -> tiny/7 so the run fits any session")
     return out
 
 

@@ -21,7 +21,9 @@ MAX_IDLE_S=${2:-43200}    # total seconds allowed waiting on a dead tunnel
 idle_s=0
 
 probe() {
-  timeout 90 python -c "import jax; print(jax.devices()[0].platform)" \
+  # -k 10: a probe hung inside TPU plugin init can ignore SIGTERM; KILL
+  # it so a dead tunnel costs 90 s, not an unbounded wait.
+  timeout -k 10 90 python -c "import jax; print(jax.devices()[0].platform)" \
     2>/dev/null | tail -1
 }
 

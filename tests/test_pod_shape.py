@@ -107,6 +107,27 @@ def test_cohort64_over_16_devices():
 
 
 @pytest.mark.slow
+def test_cohort256_over_32_devices():
+    """The north-star cohort width at pod-ish device count: 256 sampled
+    clients per round over 32 virtual devices (8/device), 512 residents —
+    the shape VERDICT r4 missing #5 asked for."""
+    child = os.path.join(_REPO, "tests", "pod_child.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    r = subprocess.run(
+        [sys.executable, child, "32", "256", "512"],
+        capture_output=True, text=True, timeout=1200, env=env, cwd=_REPO,
+    )
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("POD ")]
+    assert line, r.stdout[-2000:]
+    out = json.loads(line[-1][4:])
+    assert out["cohort_per_device"] == 8
+    assert out["completed"] == [256, 256]
+    assert out["train_loss"][1] < out["train_loss"][0]  # learning
+
+
+@pytest.mark.slow
 def test_dryrun_multichip_32(tmp_path):
     """The driver gate's own entry at pod-ish scale: 32 virtual devices,
     both the 1-D client mesh and the 3-D (8, 2, 2) MoE-BERT mesh."""
