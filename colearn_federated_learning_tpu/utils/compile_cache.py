@@ -1,0 +1,58 @@
+"""Host-keyed persistent XLA compile cache.
+
+One shared implementation of the scheme that previously lived as three
+diverging copies (tests/conftest.py, __graft_entry__.py,
+scripts/run_baseline_configs.py): persist compiled executables under a
+directory keyed by the host's CPU feature set — XLA:CPU AOT results
+loaded on a host with different features can SIGILL — so the first run
+pays the compile (a full-size BERT round program costs ~15 min on one
+CPU core) and every later run on the same host loads it in seconds.
+
+Best-effort by design: cache setup must never break the caller, so every
+failure path degrades to "no persistent cache".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def host_key() -> str:
+    """Stable 10-hex digest of this host's CPU feature lines."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            # x86 lists "flags", aarch64 lists "Features".
+            feats = sorted(
+                {line for line in f if line.startswith(("flags", "Features"))}
+            )
+    except OSError:
+        feats = []
+    if not feats:
+        import platform
+
+        feats = [platform.machine(), platform.processor()]
+    return hashlib.sha1("".join(feats).encode()).hexdigest()[:10]
+
+
+def enable_host_keyed_cache(root: str, dirname: str = ".jax_cache",
+                            export_env: bool = False) -> str | None:
+    """Point jax's persistent compilation cache at <root>/<dirname>/<hostkey>.
+
+    ``export_env=True`` additionally exports JAX_COMPILATION_CACHE_DIR /
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS so spawned subprocesses
+    (multi-process tests, CLI federation children) share the cache.
+    Returns the cache path, or None if setup failed.
+    """
+    try:
+        import jax
+
+        cache = os.path.join(root, dirname, host_key())
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        if export_env:
+            os.environ["JAX_COMPILATION_CACHE_DIR"] = cache
+            os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
+        return cache
+    except Exception:
+        return None

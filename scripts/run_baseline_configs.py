@@ -32,6 +32,14 @@ if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
 
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent host-keyed compile cache: a full-size BERT round program
+# costs ~15 min of XLA:CPU compile — pay it once per HOST, not per run.
+from colearn_federated_learning_tpu.utils.compile_cache import (  # noqa: E402
+    enable_host_keyed_cache,
+)
+
+enable_host_keyed_cache(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def _vit_tiny7(model_cfg):
     """The ONE definition of the tiny/7 stand-in for ViT-B/16 (both the
@@ -155,6 +163,14 @@ def main() -> None:
     p.add_argument("--rounds", type=int, default=None,
                    help="override every selected config's round count "
                         "(e.g. run the text configs to plateau)")
+    p.add_argument("--max-examples", type=int, default=None,
+                   help="override examples/client (scales local steps per "
+                        "round: epochs * ceil(examples/batch)) - lets a "
+                        "slow session trade steps-per-round for rounds")
+    p.add_argument("--lr", type=float, default=None,
+                   help="override the client peak lr (recipes tuned on "
+                        "scaled stand-ins don't always transfer: 5e-5 "
+                        "diverges on the FULL 768x12 BERT in bf16)")
     args = p.parse_args()
 
     import jax
@@ -170,6 +186,14 @@ def main() -> None:
         if args.rounds:
             cfg = cfg.replace(
                 fed=dataclasses.replace(cfg.fed, rounds=args.rounds))
+        if args.max_examples is not None:
+            # NOT truthiness: 0 is the documented "derive from dataset
+            # size" value and must round-trip.
+            cfg = cfg.replace(
+                data=dataclasses.replace(cfg.data,
+                                         max_examples_per_client=args.max_examples))
+        if args.lr is not None:
+            cfg = cfg.replace(fed=dataclasses.replace(cfg.fed, lr=args.lr))
         print(f"[{name}] {note}", file=sys.stderr)
         t0 = time.perf_counter()
         learner = FederatedLearner.from_config(cfg)

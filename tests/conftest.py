@@ -26,32 +26,16 @@ jax.config.update("jax_platforms", "cpu")
 # run (or another xdist worker) already built.  Keyed by host CPU features
 # like __graft_entry__'s cache — XLA:CPU AOT results can SIGILL on a
 # different host.
-try:
-    import hashlib
+# One shared implementation (utils/compile_cache.py); export_env=True so
+# the multi-process tests (CLI federation, DCN children) spawn fresh
+# interpreters that share the cache instead of recompiling every program
+# from scratch — the single biggest suite cost.
+from colearn_federated_learning_tpu.utils.compile_cache import (  # noqa: E402
+    enable_host_keyed_cache,
+)
 
-    try:
-        with open("/proc/cpuinfo") as _f:
-            _feats = sorted(
-                {line for line in _f if line.startswith(("flags", "Features"))}
-            )
-    except OSError:
-        _feats = []
-    if not _feats:
-        import platform
-
-        _feats = [platform.machine(), platform.processor()]
-    _hostkey = hashlib.sha1("".join(_feats).encode()).hexdigest()[:10]
-    _cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_test_cache", _hostkey)
-    jax.config.update("jax_compilation_cache_dir", _cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    # Export via env too: the multi-process tests (CLI federation, DCN
-    # children) spawn fresh interpreters that would otherwise recompile
-    # every program from scratch — the single biggest suite cost.
-    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
-    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
-except Exception:
-    pass
+enable_host_keyed_cache(os.path.dirname(os.path.abspath(__file__)),
+                        dirname=".jax_test_cache", export_env=True)
 
 import sys
 
