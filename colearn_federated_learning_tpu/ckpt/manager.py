@@ -11,9 +11,12 @@ killed experiment resumes exactly where it stopped.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 import orbax.checkpoint as ocp
+
+from colearn_federated_learning_tpu.telemetry import registry as _metrics
 
 
 class RoundCheckpointer:
@@ -38,6 +41,7 @@ class RoundCheckpointer:
         )
 
     def save(self, step: int, server_state: Any, history: list[dict]) -> None:
+        t0 = time.perf_counter()
         self._mgr.save(
             step,
             args=ocp.args.Composite(
@@ -46,6 +50,9 @@ class RoundCheckpointer:
             ),
         )
         self._mgr.wait_until_finished()
+        reg = _metrics.get_registry()
+        reg.counter("ckpt.saves_total").inc()
+        reg.histogram("ckpt.save_s").observe(time.perf_counter() - t0)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -57,6 +64,7 @@ class RoundCheckpointer:
         step = self._mgr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        t0 = time.perf_counter()
         restored = self._mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -64,6 +72,9 @@ class RoundCheckpointer:
                 history=ocp.args.JsonRestore(),
             ),
         )
+        reg = _metrics.get_registry()
+        reg.counter("ckpt.restores_total").inc()
+        reg.histogram("ckpt.restore_s").observe(time.perf_counter() - t0)
         return restored["state"], list(restored["history"]), step
 
     def close(self) -> None:

@@ -92,6 +92,12 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-every", type=int, default=None)
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of rounds 1-2 here")
+    p.add_argument("--trace-dir", default=None,
+                   help="write a Chrome-trace JSON of per-round phase spans "
+                        "here (open in Perfetto / chrome://tracing, or use "
+                        "`colearn trace-summary`)")
+    p.add_argument("--trace-rounds", type=int, default=None,
+                   help="span-trace only the first N rounds (0 = all)")
     p.add_argument("--attn-impl", default=None,
                    choices=["dense", "flash", "ring", "ulysses"],
                    help="attention core (models/attention.py)")
@@ -118,7 +124,7 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _MODEL_KEYS = {"attn_impl", "remat", "stem", "norm", "width"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
-             "checkpoint_every", "profile_dir"}
+             "checkpoint_every", "profile_dir", "trace_dir", "trace_rounds"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
@@ -184,6 +190,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                 ("--personalize-steps", bool(args.personalize_steps)),
                 ("--checkpoint-dir", bool(config.run.checkpoint_dir)),
                 ("--profile-dir", bool(config.run.profile_dir)),
+                ("--trace-dir", bool(config.run.trace_dir)),
             ] if on
         ]
         if unsupported:
@@ -240,6 +247,8 @@ def cmd_train(args: argparse.Namespace) -> int:
         # Which registry branch fed the run — so a user who staged real
         # data under $COLEARN_DATA_DIR can confirm it was actually used.
         summary["data_source"] = learner.dataset.source
+        if learner.last_trace_path:
+            summary["trace_file"] = learner.last_trace_path
         print(json.dumps(summary))
     return 0
 
@@ -302,6 +311,20 @@ def cmd_worker(args: argparse.Namespace) -> int:
     run_worker_forever(config, args.client_id, args.broker_host,
                        args.broker_port, mud_profile=mud)
     return 0
+
+
+def _write_coordinator_trace(config, coord) -> None:
+    """Flush the coordinator's span buffer (round phases + adopted worker
+    spans) to a Chrome-trace JSON when --trace-dir is set."""
+    if not config.run.trace_dir:
+        return
+    from colearn_federated_learning_tpu import telemetry
+
+    path = telemetry.write_tracer(
+        config.run.trace_dir, config.run.name, coord.tracer,
+        metrics=telemetry.get_registry().snapshot(),
+    )
+    print(f"trace written to {path}", file=sys.stderr)
 
 
 def cmd_coordinate(args: argparse.Namespace) -> int:
@@ -377,6 +400,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
                 log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
                 elastic=args.elastic,
             )
+            _write_coordinator_trace(config, coord)
             print(json.dumps(hist[-1]))
         return 0
     coord = FederatedCoordinator(config, args.broker_host, args.broker_port,
@@ -394,7 +418,20 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
                          elastic=args.elastic)
         if args.per_client_eval:
             print(json.dumps(coord.evaluate_per_client()), file=sys.stderr)
+        _write_coordinator_trace(config, coord)
         print(json.dumps(hist[-1]))
+    return 0
+
+
+def cmd_trace_summary(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu import telemetry
+
+    try:
+        doc = telemetry.load_trace(args.trace_file)
+    except (OSError, ValueError) as e:
+        print(f"cannot read trace {args.trace_file}: {e}", file=sys.stderr)
+        return 2
+    print(telemetry.summarize_trace(doc, root=args.root))
     return 0
 
 
@@ -523,6 +560,14 @@ def main(argv: list[str] | None = None) -> int:
                               "staleness-weighted mean every N updates "
                               "instead of running synchronous rounds")
     p_coord.set_defaults(fn=cmd_coordinate)
+
+    p_trace = sub.add_parser("trace-summary",
+                             help="print a per-phase time breakdown of a "
+                                  "--trace-dir Chrome-trace JSON file")
+    p_trace.add_argument("trace_file", help="path to the *_trace.json file")
+    p_trace.add_argument("--root", default="round",
+                         help="span name used as the per-round denominator")
+    p_trace.set_defaults(fn=cmd_trace_summary)
 
     p_bench = sub.add_parser("bench", help="run the headline benchmark")
     p_bench.add_argument("--rounds", type=int, default=20)

@@ -48,9 +48,11 @@ from colearn_federated_learning_tpu.comm.enrollment import (
     DeviceInfo,
     EnrollmentManager,
 )
+from colearn_federated_learning_tpu.comm import protocol
 from colearn_federated_learning_tpu.comm.transport import TensorClient
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu import telemetry
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 
 
@@ -107,6 +109,7 @@ class AsyncFederatedCoordinator:
         self._threads: list[threading.Thread] = []
         self.failures: dict[str, int] = {}
         self._ckpt = None
+        self.tracer = telemetry.Tracer(process="async-coordinator")
         # Async DP accounting: q = 1 (NO amplification-by-subsampling —
         # buffer membership is availability-ordered, not uniformly
         # sampled); each APPLIED aggregation is charged as one Gaussian
@@ -173,18 +176,27 @@ class AsyncFederatedCoordinator:
                 return
             v, params_np = self._snapshot()
             try:
-                header, delta = cli.request(
-                    {"op": "train", "round": v}, params_np,
-                    meta={"round": v}, timeout=self.request_timeout,
-                )
+                with self.tracer.span("dispatch_train",
+                                      device=dev.device_id, version=v):
+                    header, delta = cli.request(
+                        protocol.attach_trace(
+                            {"op": "train", "round": v},
+                            self.tracer.current_context(),
+                        ),
+                        params_np,
+                        meta={"round": v}, timeout=self.request_timeout,
+                    )
                 if header.get("status") != "ok":
                     raise RuntimeError(header.get("error"))
+                protocol.pop_trace_spans(header.get("meta"), self.tracer)
             except Exception:
                 if self._stop.is_set():
                     return
                 self.failures[dev.device_id] = (
                     self.failures.get(dev.device_id, 0) + 1
                 )
+                telemetry.get_registry().counter(
+                    "async.dispatch_failures").inc()
                 # Replace the connection (a late reply on the old socket
                 # would desynchronise the request/reply stream), back off,
                 # and RETRY the same version — last_v only advances on
@@ -257,44 +269,53 @@ class AsyncFederatedCoordinator:
         weights: list[float] = []
         discarded = 0
         stall_deadline = t0 + 2.0 * self.request_timeout
-        while len(staleness) < self.buffer_size:
-            try:
-                dev_id, meta, delta, v = self._results.get(
-                    timeout=max(0.1, stall_deadline - time.perf_counter())
-                )
-            except queue.Empty:
-                raise RuntimeError(
-                    f"no update arrived within {2 * self.request_timeout:.0f}s "
-                    f"({len(staleness)}/{self.buffer_size} buffered); "
-                    f"device failures: {dict(self.failures)}"
-                ) from None
-            stall_deadline = time.perf_counter() + 2.0 * self.request_timeout
-            tau = self.version - v
-            if tau > self.max_staleness:
-                discarded += 1
-                continue
-            w = (float(meta.get("weight", 1.0))
-                 * (1.0 + tau) ** (-self.staleness_exponent))
-            folder.add(meta, delta, weight=w)
-            staleness.append(tau)
-            contributors.append(dev_id)
-            weights.append(w)
+        with self.tracer.span("collect_updates",
+                              buffer_size=self.buffer_size) as collect_sp:
+            while len(staleness) < self.buffer_size:
+                try:
+                    dev_id, meta, delta, v = self._results.get(
+                        timeout=max(0.1, stall_deadline - time.perf_counter())
+                    )
+                except queue.Empty:
+                    raise RuntimeError(
+                        f"no update arrived within "
+                        f"{2 * self.request_timeout:.0f}s "
+                        f"({len(staleness)}/{self.buffer_size} buffered); "
+                        f"device failures: {dict(self.failures)}"
+                    ) from None
+                stall_deadline = (time.perf_counter()
+                                  + 2.0 * self.request_timeout)
+                tau = self.version - v
+                if tau > self.max_staleness:
+                    discarded += 1
+                    continue
+                w = (float(meta.get("weight", 1.0))
+                     * (1.0 + tau) ** (-self.staleness_exponent))
+                folder.add(meta, delta, weight=w)
+                staleness.append(tau)
+                contributors.append(dev_id)
+                weights.append(w)
 
-        mean_delta, total_w, mean_loss = folder.mean()
-        with self._state_lock:
-            if mean_delta is not None:
-                self.server_state = strategies.server_update(
-                    self.server_state, mean_delta, self.config.fed
-                )
-            # The version bump happens under BOTH locks: _state_lock keeps
-            # (server_state, version) consistent for _snapshot, and holding
-            # _version_cv across increment+notify closes the lost-wakeup
-            # window a pump would otherwise hit between reading version and
-            # calling wait() (today's 0.1 s poll would mask it, but the
-            # poll must not be load-bearing).
-            with self._version_cv:
-                self.version += 1
-                self._version_cv.notify_all()
+        with self.tracer.span("apply_update",
+                              version=self.version) as apply_sp:
+            mean_delta, total_w, mean_loss = folder.mean()
+            with self._state_lock:
+                if mean_delta is not None:
+                    self.server_state = strategies.server_update(
+                        self.server_state, mean_delta, self.config.fed
+                    )
+                # The version bump happens under BOTH locks: _state_lock
+                # keeps (server_state, version) consistent for _snapshot,
+                # and holding _version_cv across increment+notify closes
+                # the lost-wakeup window a pump would otherwise hit between
+                # reading version and calling wait() (today's 0.1 s poll
+                # would mask it, but the poll must not be load-bearing).
+                with self._version_cv:
+                    self.version += 1
+                    self._version_cv.notify_all()
+        reg = telemetry.get_registry()
+        reg.counter("async.aggregations_total").inc()
+        reg.counter("async.updates_discarded_stale").inc(discarded)
         rec = {
             "aggregation": len(self.history),
             "model_version": self.version,
@@ -306,7 +327,10 @@ class AsyncFederatedCoordinator:
             "train_loss": mean_loss,
             "total_weight": total_w,
             "agg_time_s": time.perf_counter() - t0,
+            "phase_collect_s": collect_sp.duration_s,
+            "phase_apply_s": apply_sp.duration_s,
         }
+        reg.histogram("async.agg_time_s").observe(rec["agg_time_s"])
         if self.accountant is not None and mean_delta is not None:
             rec["dp_z_eff"] = self._charge_privacy(weights, contributors)
             rec["dp_epsilon"] = self.accountant.epsilon()
@@ -357,12 +381,17 @@ class AsyncFederatedCoordinator:
         if self.evaluator is None:
             raise RuntimeError("no evaluator was assigned")
         params_np = jax.tree.map(np.asarray, self.server_state.params)
-        header, _ = self._clients[self.evaluator.device_id].request(
-            {"op": "eval"}, params_np, timeout=self.request_timeout
-        )
+        with self.tracer.span("evaluate"):
+            header, _ = self._clients[self.evaluator.device_id].request(
+                protocol.attach_trace({"op": "eval"},
+                                      self.tracer.current_context()),
+                params_np, timeout=self.request_timeout,
+            )
         if header.get("status") != "ok":
             raise RuntimeError(f"evaluator failed: {header.get('error')}")
-        return header["meta"]
+        meta = header["meta"]
+        protocol.pop_trace_spans(meta, self.tracer)
+        return meta
 
     # ---- checkpoint/resume (same RoundCheckpointer as the engine) --------
     def _checkpointer(self):

@@ -30,10 +30,15 @@ from colearn_federated_learning_tpu.comm.enrollment import (
     DeviceInfo,
     EnrollmentManager,
 )
+from colearn_federated_learning_tpu.comm import protocol
 from colearn_federated_learning_tpu.comm.transport import TensorClient
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu import telemetry
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+
+_pop_worker_spans = protocol.pop_trace_spans
 
 
 class FederatedCoordinator:
@@ -67,6 +72,10 @@ class FederatedCoordinator:
             )
         self.round_timeout = round_timeout
         self.want_evaluator = want_evaluator
+        # Round spans live here; worker-side spans are adopted from reply
+        # metadata so one trace covers the whole federation.  The CLI
+        # writes it to RunConfig.trace_dir after fit.
+        self.tracer = telemetry.Tracer(process="coordinator")
         self._broker = BrokerClient(broker_host, broker_port)
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy,
                                          device_type=device_type)
@@ -196,14 +205,29 @@ class FederatedCoordinator:
         orphaned mask halves (Bonawitz-pattern dropout recovery) before
         the aggregate is usable."""
         r = len(self.history)
+        with self.tracer.span("round", round=r) as round_sp:
+            rec = self._run_round_traced(r)
+        rec["round_time_s"] = round_sp.duration_s
+        reg = telemetry.get_registry()
+        reg.counter("fed.rounds_total").inc()
+        reg.counter("fed.clients_dropped").inc(len(rec["dropped"]))
+        reg.counter("fed.clients_evicted").inc(len(rec["evicted"]))
+        reg.histogram("fed.round_time_s").observe(rec["round_time_s"])
+        self.history.append(rec)
+        return rec
+
+    def _run_round_traced(self, r: int) -> dict:
         cohort = self._sample_cohort(r)
-        params_np = jax.tree.map(np.asarray, self.server_state.params)
-        t0 = time.perf_counter()
+        # The thread-local round span context, captured HERE because the
+        # fan-out asks run on pool threads where it is not implicit.
+        ctx = self.tracer.current_context()
+        with self.tracer.span("serialize_params"):
+            params_np = jax.tree.map(np.asarray, self.server_state.params)
         secure = self.config.fed.secure_agg
         cohort_ids = sorted(int(d.device_id) for d in cohort)
 
         def ask(dev: DeviceInfo):
-            req = {"op": "train", "round": r}
+            req = protocol.attach_trace({"op": "train", "round": r}, ctx)
             if secure:
                 req["cohort"] = cohort_ids
             header, delta = self._clients[dev.device_id].request(
@@ -214,44 +238,51 @@ class FederatedCoordinator:
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"], delta
 
-        results, failed = self._fan_out(cohort, ask)
+        with self.tracer.span("broadcast_collect",
+                              cohort=len(cohort)) as collect_sp:
+            results, failed = self._fan_out(cohort, ask)
         dropped = [d.device_id for d in failed]
 
         from colearn_federated_learning_tpu.comm.aggregation import (
             UpdateFolder,
         )
 
-        folder = UpdateFolder(params_np)
-        received = []
-        for meta, delta in results:
-            if int(meta.get("round", r)) != r:       # stale update: refuse
-                dropped.append(str(meta.get("client_id")))
-                continue
-            folder.add(meta, delta)
-            received.append(int(meta["client_id"]))
-        folded = folder.count
+        with self.tracer.span("aggregate") as agg_sp:
+            folder = UpdateFolder(params_np)
+            received = []
+            for meta, delta in results:
+                _pop_worker_spans(meta, self.tracer)
+                if int(meta.get("round", r)) != r:   # stale update: refuse
+                    dropped.append(str(meta.get("client_id")))
+                    continue
+                folder.add(meta, delta)
+                received.append(int(meta["client_id"]))
+            folded = folder.count
 
-        unmask_failed = False
-        if secure and folded:
-            missing = sorted(set(cohort_ids) - set(received))
-            if missing:
-                unmask_failed = not self._unmask_dropped(
-                    r, cohort_ids, received, missing, folder
+            unmask_failed = False
+            if secure and folded:
+                missing = sorted(set(cohort_ids) - set(received))
+                if missing:
+                    with self.tracer.span("unmask",
+                                          dropped=len(missing)):
+                        unmask_failed = not self._unmask_dropped(
+                            r, cohort_ids, received, missing, folder
+                        )
+            mean_delta, total_w, mean_loss = folder.mean()
+            if unmask_failed:
+                # Orphaned mask halves would corrupt the aggregate; a
+                # no-op round is the safe failure (same convention as
+                # zero weight).
+                mean_delta = None
+                mean_loss = float("nan")
+            if secure:
+                # Workers omit per-client losses under secure aggregation
+                # (the per-client statistic is what the masks hide).
+                mean_loss = float("nan")
+            if mean_delta is not None:
+                self.server_state = strategies.server_update(
+                    self.server_state, mean_delta, self.config.fed
                 )
-        mean_delta, total_w, mean_loss = folder.mean()
-        if unmask_failed:
-            # Orphaned mask halves would corrupt the aggregate; a no-op
-            # round is the safe failure (same convention as zero weight).
-            mean_delta = None
-            mean_loss = float("nan")
-        if secure:
-            # Workers omit per-client losses under secure aggregation (the
-            # per-client statistic is what the masks hide).
-            mean_loss = float("nan")
-        if mean_delta is not None:
-            self.server_state = strategies.server_update(
-                self.server_state, mean_delta, self.config.fed
-            )
         evicted = self._note_round_outcome(cohort, dropped)
         rec = {
             "round": r,
@@ -261,7 +292,8 @@ class FederatedCoordinator:
             "evicted": evicted,
             "train_loss": mean_loss,
             "total_weight": total_w,
-            "round_time_s": time.perf_counter() - t0,
+            "phase_broadcast_collect_s": collect_sp.duration_s,
+            "phase_aggregate_s": agg_sp.duration_s,
         }
         if secure:
             rec["unmask_failed"] = unmask_failed
@@ -284,7 +316,6 @@ class FederatedCoordinator:
                                      noise_multiplier=sigma_eff)
             rec["dp_epsilon"] = self.accountant.epsilon()
             rec["dp_delta"] = self.accountant.delta
-        self.history.append(rec)
         return rec
 
     def _unmask_dropped(self, r: int, cohort_ids, received, missing,
@@ -308,10 +339,13 @@ class FederatedCoordinator:
                 return False
             devs.append(dev)
 
+        ctx = self.tracer.current_context()
+
         def ask(dev: DeviceInfo):
             header, mask = self._clients[dev.device_id].request(
-                {"op": "unmask", "round": r, "dropped": missing,
-                 "cohort": cohort_ids},
+                protocol.attach_trace(
+                    {"op": "unmask", "round": r, "dropped": missing,
+                     "cohort": cohort_ids}, ctx),
                 None, timeout=self.round_timeout,
             )
             if header.get("status") != "ok":
@@ -320,6 +354,7 @@ class FederatedCoordinator:
 
         results, failed = self._fan_out(devs, ask)
         for meta, mask in results:
+            _pop_worker_spans(meta, self.tracer)
             if int(meta.get("n_dropped_pairs", 0)) == 0 or mask is None:
                 continue
             folder.wsum = pytrees.tree_sub(
@@ -338,16 +373,20 @@ class FederatedCoordinator:
                 "per-client statistics are exactly what the masks hide"
             )
         params_np = jax.tree.map(np.asarray, self.server_state.params)
+        ctx = self.tracer.current_context()
 
         def ask(dev: DeviceInfo):
             header, _ = self._clients[dev.device_id].request(
-                {"op": "self_eval"}, params_np, timeout=self.round_timeout,
+                protocol.attach_trace({"op": "self_eval"}, ctx),
+                params_np, timeout=self.round_timeout,
             )
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"]
 
         metas, _ = self._fan_out(self.trainers, ask)
+        for m in metas:
+            _pop_worker_spans(m, self.tracer)
         if not metas:
             return {"num_clients_evaluated": 0}
         from colearn_federated_learning_tpu.fed.evaluation import (
@@ -368,12 +407,17 @@ class FederatedCoordinator:
         if self.evaluator is None:
             raise RuntimeError("no evaluator was assigned")
         params_np = jax.tree.map(np.asarray, self.server_state.params)
-        header, _ = self._clients[self.evaluator.device_id].request(
-            {"op": "eval"}, params_np, timeout=self.round_timeout
-        )
+        with self.tracer.span("evaluate"):
+            header, _ = self._clients[self.evaluator.device_id].request(
+                protocol.attach_trace({"op": "eval"},
+                                      self.tracer.current_context()),
+                params_np, timeout=self.round_timeout,
+            )
         if header.get("status") != "ok":
             raise RuntimeError(f"evaluator failed: {header.get('error')}")
-        return header["meta"]
+        meta = header["meta"]
+        _pop_worker_spans(meta, self.tracer)
+        return meta
 
     # ---- checkpoint/resume (same RoundCheckpointer as the engine) --------
     def _checkpointer(self):

@@ -14,10 +14,50 @@ import socket
 import struct
 from typing import Optional
 
+from colearn_federated_learning_tpu.telemetry import registry as _metrics
+
 _HDR = struct.Struct(">I")     # header length
 _BODY = struct.Struct(">Q")    # body length
 MAX_HEADER = 1 << 20           # 1 MiB of JSON is already absurd
 MAX_BODY = 1 << 34             # 16 GiB
+
+TRACE_KEY = "trace"            # header slot carrying the trace context
+
+
+def attach_trace(header: dict, context) -> dict:
+    """Inject a tracer span context ``(trace_id, span_id)`` into a message
+    header (in place), so the receiver's spans stitch under the sender's.
+    A ``None`` context is a no-op — untraced senders stay untraced."""
+    if context is not None:
+        header[TRACE_KEY] = {"trace_id": context[0], "span_id": context[1]}
+    return header
+
+
+def extract_trace(header: dict):
+    """Inverse of :func:`attach_trace`; returns a span context or None.
+    Tolerates malformed values — a peer's bad header must degrade to an
+    unstitched trace, not an error."""
+    ctx = header.get(TRACE_KEY)
+    if not isinstance(ctx, dict):
+        return None
+    trace_id, span_id = ctx.get("trace_id"), ctx.get("span_id")
+    if not (isinstance(trace_id, str) and isinstance(span_id, str)):
+        return None
+    return (trace_id, span_id)
+
+
+TRACE_SPANS_KEY = "trace_spans"  # reply-meta slot carrying worker spans
+
+
+def pop_trace_spans(meta, tracer) -> None:
+    """Stitch a reply's worker-side spans into the local trace and strip
+    them from the metadata — they must not leak into round records or
+    metrics JSONL, which consume reply metas wholesale."""
+    if not isinstance(meta, dict):
+        return
+    spans = meta.pop(TRACE_SPANS_KEY, None)
+    if spans:
+        tracer.adopt(spans)
 
 
 class ConnectionClosed(Exception):
@@ -41,6 +81,11 @@ def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
     sock.sendall(_HDR.pack(len(hdr)) + hdr + _BODY.pack(len(body)))
     if body:
         sock.sendall(body)
+    reg = _metrics.get_registry()
+    reg.counter("comm.messages_sent").inc()
+    reg.counter("comm.bytes_sent").inc(
+        _HDR.size + len(hdr) + _BODY.size + len(body)
+    )
 
 
 def recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
@@ -52,6 +97,11 @@ def recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
     if blen > MAX_BODY:
         raise ValueError(f"corrupt frame: body length {blen}")
     body = _recv_exact(sock, blen) if blen else b""
+    reg = _metrics.get_registry()
+    reg.counter("comm.messages_received").inc()
+    reg.counter("comm.bytes_received").inc(
+        _HDR.size + hlen + _BODY.size + blen
+    )
     return header, body
 
 

@@ -29,7 +29,9 @@ import numpy as np
 
 from colearn_federated_learning_tpu.comm.broker import BrokerClient
 from colearn_federated_learning_tpu.comm import enrollment
+from colearn_federated_learning_tpu.comm import protocol
 from colearn_federated_learning_tpu.comm.transport import TensorServer
+from colearn_federated_learning_tpu.telemetry import Tracer
 from colearn_federated_learning_tpu.data import registry as data_registry
 from colearn_federated_learning_tpu.data.sharding import pack_client_shards
 from colearn_federated_learning_tpu.fed import setup as setup_lib
@@ -125,6 +127,13 @@ class DeviceWorker:
         self._eval_fn = None          # built on first eval request
         self._key = prng.experiment_key(c.run.seed)
 
+        # Span tracer for this device.  Recording into the local buffer
+        # stays OFF (a long-lived worker must not grow a span log); each
+        # traced request's spans are captured per-thread and shipped back
+        # in the reply metadata, where the coordinator stitches them into
+        # its trace via the propagated trace id.
+        self.tracer = Tracer(process=f"worker-{self.client_id}",
+                             enabled=False)
         self._server = TensorServer(self._handle, host=host, port=port)
         self._broker: Optional[BrokerClient] = None
         self._broker_addr = (broker_host, broker_port)
@@ -193,7 +202,26 @@ class DeviceWorker:
 
     # ------------------------------------------------------------------
     def _handle(self, header: dict, tree: Any) -> tuple[dict, Any]:
+        """Dispatch one request under a ``worker.<op>`` span.  When the
+        request carries a trace context (protocol.attach_trace on the
+        coordinator side), this span parents onto the coordinator's round
+        span and every span finished while handling the request is
+        returned in the reply meta for cross-process stitching."""
         op = header.get("op")
+        ctx = protocol.extract_trace(header)
+        attrs = {"client_id": self.client_id}
+        if "round" in header:
+            attrs["round"] = header["round"]
+        with self.tracer.capture() as captured:
+            with self.tracer.span(f"worker.{op}", parent=ctx, **attrs):
+                out_header, out_tree = self._dispatch(op, header, tree)
+        if ctx is not None and "meta" in out_header:
+            out_header["meta"][protocol.TRACE_SPANS_KEY] = [
+                s.to_dict() for s in captured
+            ]
+        return out_header, out_tree
+
+    def _dispatch(self, op, header: dict, tree: Any) -> tuple[dict, Any]:
         if op == "train":
             return self._train(int(header.get("round", 0)), tree,
                                cohort=header.get("cohort"))
@@ -283,13 +311,19 @@ class DeviceWorker:
 
     def _train(self, round_idx: int, global_params: Any,
                cohort=None) -> tuple[dict, Any]:
-        params = jax.tree.map(jnp.asarray, global_params)
-        result = self._update_fn(
-            params, self._x, self._y, self._count,
-            prng.client_round_key(self._key, self.client_id, round_idx),
-            jnp.asarray(self._num_steps, jnp.int32),
-            strategies.lr_scale_for_round(self.config.fed, round_idx),
-        )
+        with self.tracer.span("deserialize_params"):
+            params = jax.tree.map(jnp.asarray, global_params)
+        with self.tracer.span("local_train", steps=self._num_steps):
+            result = self._update_fn(
+                params, self._x, self._y, self._count,
+                prng.client_round_key(self._key, self.client_id, round_idx),
+                jnp.asarray(self._num_steps, jnp.int32),
+                strategies.lr_scale_for_round(self.config.fed, round_idx),
+            )
+            # The update is dispatched asynchronously; settle it here so
+            # the span (and not the later serialization) carries the
+            # compute time.
+            jax.block_until_ready(result.delta)
         delta, weight = setup_lib.finalize_client_delta(
             self.config, result, self.client_id, round_idx
         )
@@ -302,20 +336,23 @@ class DeviceWorker:
             # the engine's secure path.
             from colearn_federated_learning_tpu.privacy import secure_agg as sa
 
-            delta_f32 = jax.tree.map(lambda l: l.astype(jnp.float32), delta)
-            partners = self._partner_row(round_idx, cohort)
-            if self._dh_mode:
-                pair_keys, signs = self._dh_pair_keys(partners, round_idx)
-                delta = sa.mask_update_with_keys(
-                    delta_f32, pair_keys, signs,
-                    jnp.asarray(round_idx, jnp.int32),
+            with self.tracer.span("secure_mask", dh=self._dh_mode):
+                delta_f32 = jax.tree.map(
+                    lambda l: l.astype(jnp.float32), delta
                 )
-            else:
-                delta = sa.mask_update(
-                    delta_f32, self._key,
-                    jnp.asarray(self.client_id, jnp.int32), partners,
-                    jnp.asarray(round_idx, jnp.int32),
-                )
+                partners = self._partner_row(round_idx, cohort)
+                if self._dh_mode:
+                    pair_keys, signs = self._dh_pair_keys(partners, round_idx)
+                    delta = sa.mask_update_with_keys(
+                        delta_f32, pair_keys, signs,
+                        jnp.asarray(round_idx, jnp.int32),
+                    )
+                else:
+                    delta = sa.mask_update(
+                        delta_f32, self._key,
+                        jnp.asarray(self.client_id, jnp.int32), partners,
+                        jnp.asarray(round_idx, jnp.int32),
+                    )
             weight = 1.0
         meta = {"round": round_idx, "weight": weight,
                 "client_id": self.client_id,
@@ -326,9 +363,11 @@ class DeviceWorker:
             meta["mean_loss"] = float(result.mean_loss)
         from colearn_federated_learning_tpu.fed import compression
 
-        wire, cmeta = compression.compress_delta(
-            jax.tree.map(np.asarray, delta), self.config.fed.compress
-        )
+        with self.tracer.span("compress_delta",
+                              codec=self.config.fed.compress):
+            wire, cmeta = compression.compress_delta(
+                jax.tree.map(np.asarray, delta), self.config.fed.compress
+            )
         meta.update(cmeta)
         return ({"meta": meta}, wire)
 

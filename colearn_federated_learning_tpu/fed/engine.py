@@ -42,6 +42,7 @@ from colearn_federated_learning_tpu.fed.evaluation import (
 )
 from colearn_federated_learning_tpu.models import registry as model_registry
 from colearn_federated_learning_tpu.privacy import dp as dp_lib
+from colearn_federated_learning_tpu import telemetry
 from colearn_federated_learning_tpu.utils import prng
 from colearn_federated_learning_tpu.utils import config as config_lib
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
@@ -430,6 +431,11 @@ class FederatedLearner:
         self.base_key = prng.experiment_key(c.run.seed)
         self._round_fn = programs.build_round_fn(self)
         self._eval_fn = self._build_eval_fn()
+        # Recording stays off until fit() opens a trace window (trace_dir);
+        # span() still yields timed spans either way, so run_round's phase
+        # durations are always available to the metrics JSONL.
+        self.tracer = telemetry.Tracer(process="engine", enabled=False)
+        self.last_trace_path: Optional[str] = None
         self._device_data = self._place_data()
         self.history: list[dict] = []
         self._ckpt = None
@@ -438,20 +444,28 @@ class FederatedLearner:
     # data placement
     # ------------------------------------------------------------------
     def _place_data(self):
-        x = jnp.asarray(self.shards.x)
-        y = jnp.asarray(self.shards.y)
-        counts = jnp.asarray(self.shards.counts)
-        ids = jnp.asarray(self.client_ids)
-        if self.mesh is not None:
-            ax = self.client_axis
-            # Under SP each client's token dim is also sharded (last axis of
-            # the (clients, capacity, seq_len) block).
-            x_spec = (
-                P(ax, None, self.seq_axis) if self.sp else P(ax)
-            )
-            x = jax.device_put(x, NamedSharding(self.mesh, x_spec))
-            sh = NamedSharding(self.mesh, P(ax))
-            y, counts, ids = (jax.device_put(a, sh) for a in (y, counts, ids))
+        with self.tracer.span("h2d_transfer") as sp:
+            x = jnp.asarray(self.shards.x)
+            y = jnp.asarray(self.shards.y)
+            counts = jnp.asarray(self.shards.counts)
+            ids = jnp.asarray(self.client_ids)
+            if self.mesh is not None:
+                ax = self.client_axis
+                # Under SP each client's token dim is also sharded (last
+                # axis of the (clients, capacity, seq_len) block).
+                x_spec = (
+                    P(ax, None, self.seq_axis) if self.sp else P(ax)
+                )
+                x = jax.device_put(x, NamedSharding(self.mesh, x_spec))
+                sh = NamedSharding(self.mesh, P(ax))
+                y, counts, ids = (
+                    jax.device_put(a, sh) for a in (y, counts, ids)
+                )
+            y, counts, ids = jax.block_until_ready((y, counts, ids))
+            x = jax.block_until_ready(x)
+        telemetry.get_registry().gauge("engine.h2d_transfer_s").set(
+            sp.duration_s
+        )
         return (x, y, counts, ids)
 
     # ------------------------------------------------------------------
@@ -530,46 +544,68 @@ class FederatedLearner:
             # Gather the cohort's variates from the host store; scatter the
             # refreshed block back afterwards (device memory stays
             # O(cohort × model)).
-            sel, rows = self._host_sample_cohort(r)
-            c_cohort = jax.tree.map(lambda l: l[rows], self.client_c)
-            sel_dev = jnp.asarray(sel)
-            if self.mesh is not None:
-                sh = NamedSharding(self.mesh, P(self.client_axis))
-                sel_dev = jax.device_put(sel_dev, sh)
-                c_cohort = jax.tree.map(
-                    lambda l: jax.device_put(jnp.asarray(l), sh), c_cohort
-                )
+            with self.tracer.span("cohort_sample", round=r) as sample_sp:
+                sel, rows = self._host_sample_cohort(r)
+                c_cohort = jax.tree.map(lambda l: l[rows], self.client_c)
+                sel_dev = jnp.asarray(sel)
+                if self.mesh is not None:
+                    sh = NamedSharding(self.mesh, P(self.client_axis))
+                    sel_dev = jax.device_put(sel_dev, sh)
+                    c_cohort = jax.tree.map(
+                        lambda l: jax.device_put(jnp.asarray(l), sh), c_cohort
+                    )
         else:
+            # The non-scaffold cohort is sampled INSIDE the jit program, so
+            # its cost is part of the fused client_update span.
             sel, rows, sel_dev, c_cohort = None, None, None, None
-        self.server_state, metrics, new_c = self._round_fn(
-            self.server_state,
-            self.base_key,
-            jnp.asarray(r, jnp.int32),
-            *self._device_data,
-            sel_dev,
-            c_cohort,
-            self._dp_clip,
-        )
+            sample_sp = None
+        # The round program is ONE fused jit call (sample → local SGD →
+        # aggregate → server update); phases inside it can't be split
+        # without extra device barriers, so it gets a single span — made
+        # honest by a barrier only while a trace window is open (blocking
+        # every round would serialise the sync=False pipeline).
+        with self.tracer.span("client_update", round=r,
+                              cohort=self.cohort_size) as update_sp:
+            self.server_state, metrics, new_c = self._round_fn(
+                self.server_state,
+                self.base_key,
+                jnp.asarray(r, jnp.int32),
+                *self._device_data,
+                sel_dev,
+                c_cohort,
+                self._dp_clip,
+            )
+            if self.tracer.enabled:
+                jax.block_until_ready(self.server_state.params)
         if self.adaptive_clip:
             # Feed the adapted clip into the next round as a device scalar
             # (no host round-trip; sync=False rounds keep pipelining).
             self._dp_clip = metrics["dp_clip"]
         if self.scaffold:
-            updated = jax.tree.map(np.asarray, new_c)
+            with self.tracer.span("scatter_variates", round=r):
+                updated = jax.tree.map(np.asarray, new_c)
 
-            def scatter(full, upd):
-                full[rows] = upd
-                return full
+                def scatter(full, upd):
+                    full[rows] = upd
+                    return full
 
-            self.client_c = jax.tree.map(scatter, self.client_c, updated)
-        if sync:
-            # ONE batched device→host transfer for the whole metrics dict —
-            # per-scalar float() would cost one RPC round-trip each on
-            # remote-tunnel platforms (65 ms × n_metrics per round).
-            out = {k: float(v) for k, v in jax.device_get(metrics).items()}
-        else:
-            out = dict(metrics)          # device scalars; sync deferred
+                self.client_c = jax.tree.map(scatter, self.client_c, updated)
+        with self.tracer.span("sync_metrics", round=r) as sync_sp:
+            if sync:
+                # ONE batched device→host transfer for the whole metrics
+                # dict — per-scalar float() would cost one RPC round-trip
+                # each on remote-tunnel platforms (65 ms × n_metrics per
+                # round).
+                out = {k: float(v)
+                       for k, v in jax.device_get(metrics).items()}
+            else:
+                out = dict(metrics)      # device scalars; sync deferred
         out["round"] = r
+        out["phase_update_s"] = update_sp.duration_s
+        out["phase_sync_s"] = sync_sp.duration_s
+        if sample_sp is not None:
+            out["phase_cohort_sample_s"] = sample_sp.duration_s
+        telemetry.get_registry().counter("engine.rounds_total").inc()
         if self.accountant is not None:
             self.accountant.step()
             out["dp_epsilon"] = self.accountant.epsilon()
@@ -792,38 +828,51 @@ class FederatedLearner:
         ckpt_every = max(0, run.checkpoint_every)
         want_ckpt = bool(run.checkpoint_dir)
         last_round = len(self.history) + rounds - 1  # fit() may be called again
-        from colearn_federated_learning_tpu.utils.profiling import RoundProfiler
-
-        profiler = RoundProfiler(run.profile_dir)
+        telem = telemetry.RoundTelemetry(run, self.tracer)
         try:
             for _ in range(rounds):
                 t0 = time.perf_counter()
-                profiler.before_round(len(self.history))
-                rec = self.run_round()
-                if profiler._active:
-                    # The trace window must contain the round's device work —
-                    # only synchronise while actually tracing (blocking every
-                    # round would serialise the async dispatch pipeline).
-                    jax.block_until_ready(self.server_state.params)
-                profiler.after_round(rec["round"])
-                rec["round_time_s"] = time.perf_counter() - t0
-                if rec["round"] % eval_every == 0 or rec["round"] == last_round:
-                    loss, acc = self.evaluate()
-                    rec["eval_loss"], rec["eval_acc"] = loss, acc
-                if log_fn is not None and (
-                    rec["round"] % log_every == 0 or rec["round"] == last_round
-                ):
-                    log_fn(rec)
-                # With a checkpoint_dir, the final round ALWAYS checkpoints
-                # even when no periodic cadence is configured, so --resume
-                # works.
-                if want_ckpt and (
-                    (ckpt_every and (rec["round"] + 1) % ckpt_every == 0)
-                    or rec["round"] == last_round
-                ):
-                    self.save_checkpoint()
+                telem.before_round(len(self.history))
+                with self.tracer.span("round", round=len(self.history)):
+                    rec = self.run_round()
+                    if telem.profiling and not self.tracer.enabled:
+                        # The jax trace window must contain the round's
+                        # device work — only synchronise while actually
+                        # profiling (blocking every round would serialise
+                        # the async dispatch pipeline; the span tracer
+                        # already put up its own barrier in run_round).
+                        jax.block_until_ready(self.server_state.params)
+                    telem.after_round(rec["round"])
+                    rec["round_time_s"] = time.perf_counter() - t0
+                    if (rec["round"] % eval_every == 0
+                            or rec["round"] == last_round):
+                        with self.tracer.span("evaluate") as ev_sp:
+                            loss, acc = self.evaluate()
+                        rec["eval_loss"], rec["eval_acc"] = loss, acc
+                        rec["phase_eval_s"] = ev_sp.duration_s
+                    if log_fn is not None and (
+                        rec["round"] % log_every == 0
+                        or rec["round"] == last_round
+                    ):
+                        log_fn(rec)
+                    # With a checkpoint_dir, the final round ALWAYS
+                    # checkpoints even when no periodic cadence is
+                    # configured, so --resume works.
+                    if want_ckpt and (
+                        (ckpt_every and (rec["round"] + 1) % ckpt_every == 0)
+                        or rec["round"] == last_round
+                    ):
+                        with self.tracer.span("checkpoint") as ck_sp:
+                            self.save_checkpoint()
+                        rec["phase_checkpoint_s"] = ck_sp.duration_s
+                telemetry.get_registry().histogram(
+                    "engine.round_time_s").observe(rec["round_time_s"])
+                # end_round AFTER the round span closed — an early window
+                # flush must include the final traced round.
+                telem.end_round(rec["round"])
         finally:
             # An exception mid-window (eval/log/ckpt) must not leave the
-            # process-global jax profiler trace running.
-            profiler.close()
+            # process-global jax profiler trace running, and whatever spans
+            # were recorded still reach disk.
+            self.last_trace_path = telem.close()
         return self.history

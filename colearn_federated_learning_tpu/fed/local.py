@@ -114,6 +114,13 @@ def make_local_update(
       on every shard, so params stay replicated through local training.
     """
     min_steps = max(1, int(num_steps * min_steps_fraction))
+    # Build-time only — the returned closure is jit-traced, where Python
+    # side effects would silently run once and vanish.
+    from colearn_federated_learning_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    reg.counter("local.trainers_built").inc()
+    reg.gauge("local.steps_per_round").set(num_steps)
 
     def loss_fn(params, global_params, xb, yb):
         if aux_loss_weight > 0.0:

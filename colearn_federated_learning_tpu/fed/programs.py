@@ -30,7 +30,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from colearn_federated_learning_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from colearn_federated_learning_tpu.fed import strategies

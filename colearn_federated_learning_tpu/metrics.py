@@ -26,6 +26,11 @@ class MetricsLogger:
     def __init__(self, path: Optional[str] = None, name: str = "default",
                  stream: Optional[IO] = None,
                  tensorboard_dir: Optional[str] = None):
+        if path is not None and stream is not None:
+            raise ValueError(
+                "pass either path or stream, not both (a path-opened file "
+                "would silently shadow the stream)"
+            )
         self.name = name
         self.path = path
         self._fh: Optional[IO] = stream
@@ -81,9 +86,26 @@ class MetricsLogger:
             out["acc_at_round"] = dict(accs)
         return out
 
+    def flush(self) -> None:
+        """Push buffered records to their sinks without closing anything —
+        long runs call this to make the JSONL/TensorBoard tail readable
+        mid-flight."""
+        if self._fh is not None:
+            try:
+                self._fh.flush()
+            except (OSError, ValueError):
+                pass                     # sink already closed by its owner
+        if self._tb is not None:
+            self._tb.flush()
+
     def close(self) -> None:
-        if self._fh is not None and self._owns_fh:
-            self._fh.close()
+        """Flush and release OWNED sinks.  An externally-provided stream is
+        flushed but NEVER closed — its lifetime belongs to the caller (e.g.
+        a test's StringIO, or stdout)."""
+        self.flush()
+        if self._fh is not None:
+            if self._owns_fh:
+                self._fh.close()
             self._fh = None
         if self._tb is not None:
             self._tb.close()

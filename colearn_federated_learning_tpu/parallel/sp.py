@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
-from jax import shard_map
+from colearn_federated_learning_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

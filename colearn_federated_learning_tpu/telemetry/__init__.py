@@ -1,0 +1,40 @@
+"""End-to-end round telemetry (see tracer/registry/export/lifecycle).
+
+Public surface:
+
+- :class:`Tracer` / :func:`get_tracer` — nested spans, monotonic timing,
+  cross-process trace propagation (``current_context`` + ``adopt``);
+- :class:`MetricsRegistry` / :func:`get_registry` — process-wide
+  counters, gauges, quantile histograms;
+- :mod:`.export` — Chrome-trace/Perfetto JSON writer/loader and the
+  ``colearn trace-summary`` text breakdown;
+- :class:`RoundTelemetry` — the per-round lifecycle driver shared by the
+  span tracer window and the jax profiler window.
+"""
+
+from colearn_federated_learning_tpu.telemetry.tracer import (  # noqa: F401
+    Span,
+    SpanContext,
+    Tracer,
+    get_tracer,
+    new_id,
+)
+from colearn_federated_learning_tpu.telemetry.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from colearn_federated_learning_tpu.telemetry.export import (  # noqa: F401
+    default_trace_path,
+    load_trace,
+    spans_to_chrome,
+    summarize_trace,
+    trace_spans,
+    write_trace,
+    write_tracer,
+)
+from colearn_federated_learning_tpu.telemetry.lifecycle import (  # noqa: F401
+    RoundTelemetry,
+)

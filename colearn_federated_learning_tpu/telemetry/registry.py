@@ -1,0 +1,159 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Spans answer *where the time went* inside one round; the registry holds
+the cumulative process counters a production federation is tuned by —
+bytes on the wire, dropped clients, dispatch retries, host-to-device
+transfer time — with quantile summaries for the distributions.  All
+instruments are thread-safe (the comm planes increment from fan-out and
+dispatcher threads) and dependency-free.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Union
+
+Number = Union[int, float]
+
+
+class Counter:
+    """Monotonically increasing value (bytes sent, retries, drops)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Number = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease by {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-observed value (current cohort size, h2d transfer seconds)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[float] = None
+
+    def set(self, v: Number) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Streaming distribution summary with bounded memory.
+
+    Running count/sum/min/max are exact; quantiles come from a bounded
+    sample buffer.  When the buffer fills, it is thinned by keeping every
+    other sample and the admission stride doubles — a deterministic
+    sketch (no RNG) whose bias is acceptable for the p50/p90/p99 this
+    registry reports.
+    """
+
+    def __init__(self, name: str, max_samples: int = 8192):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._samples: list[float] = []
+        self._max_samples = max_samples
+        self._stride = 1
+        self._lock = threading.Lock()
+
+    def observe(self, v: Number) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if (self.count - 1) % self._stride == 0:
+                self._samples.append(v)
+                if len(self._samples) >= self._max_samples:
+                    self._samples = self._samples[::2]
+                    self._stride *= 2
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return None
+        idx = min(len(samples) - 1, int(q * len(samples)))
+        return samples[max(0, idx)]
+
+    def summary(self) -> dict:
+        out = {"count": self.count, "sum": self.sum}
+        if self.count:
+            out.update(
+                mean=self.sum / self.count, min=self.min, max=self.max,
+                p50=self.quantile(0.50), p90=self.quantile(0.90),
+                p99=self.quantile(0.99),
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Named instruments, created on first touch (prometheus-client
+    idiom without the dependency).  Asking for an existing name with a
+    different instrument kind raises — silent type confusion would
+    corrupt both series."""
+
+    def __init__(self):
+        self._instruments: dict = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, **kw)
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} is a {type(inst).__name__}, "
+                    f"not a {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, max_samples: int = 8192) -> Histogram:
+        return self._get(name, Histogram, max_samples=max_samples)
+
+    def snapshot(self) -> dict:
+        """Flat JSON-safe dump: counters/gauges map to their value,
+        histograms to their summary dict."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out = {}
+        for name, inst in items:
+            if isinstance(inst, Counter):
+                out[name] = inst.value
+            elif isinstance(inst, Gauge):
+                out[name] = inst.value
+            else:
+                out[name] = inst.summary()
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every layer increments into; tests that
+    need isolation construct their own MetricsRegistry."""
+    return _default_registry

@@ -165,6 +165,8 @@ class RunConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0         # 0 disables
     profile_dir: Optional[str] = None  # jax.profiler trace output (rounds 1-2)
+    trace_dir: Optional[str] = None    # span-trace Chrome JSON output dir
+    trace_rounds: int = 0              # trace only the first N rounds (0 = all)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,39 @@
+"""Version shims for jax APIs the codebase targets.
+
+The code is written against the modern ``jax.shard_map`` surface
+(keyword ``mesh``/``in_specs``/``out_specs``, ``axis_names`` selecting
+the MANUAL axes, ``check_vma``).  Older jax releases (< 0.6) only ship
+``jax.experimental.shard_map.shard_map`` whose equivalent knobs are
+``auto`` (the complement of the manual axes) and ``check_rep`` — this
+module maps one surface onto the other so the rest of the tree imports
+a single name and never version-checks.
+"""
+
+from __future__ import annotations
+
+try:                                      # jax >= 0.6: public API
+    from jax import shard_map as _shard_map
+
+    HAS_NATIVE_SHARD_MAP = True
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=True):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return _shard_map(f, **kw)
+
+except ImportError:                       # jax < 0.6: experimental API
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    HAS_NATIVE_SHARD_MAP = False
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=True):
+        auto = frozenset()
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma,
+                          auto=auto)

@@ -24,6 +24,12 @@ class RoundProfiler:
         self.last = first_round + num_rounds - 1
         self._active = False
 
+    @property
+    def active(self) -> bool:
+        """Whether a jax trace window is currently open — callers that
+        need a barrier only while tracing (engine.fit) key off this."""
+        return self._active
+
     def before_round(self, round_idx: int) -> None:
         if self.profile_dir and not self._active and round_idx == self.first:
             jax.profiler.start_trace(self.profile_dir)

@@ -32,6 +32,9 @@ import jax
 
 from colearn_federated_learning_tpu.fed.engine import FederatedLearner
 from colearn_federated_learning_tpu.parallel.mesh import make_mesh
+from colearn_federated_learning_tpu.utils.jax_compat import (
+    HAS_NATIVE_SHARD_MAP,
+)
 from colearn_federated_learning_tpu.utils.config import (
     DataConfig,
     ExperimentConfig,
@@ -56,6 +59,11 @@ def _moe_ring_cfg():
     )
 
 
+@pytest.mark.skipif(
+    not HAS_NATIVE_SHARD_MAP,
+    reason="MoE expert-parallel all-to-all aborts the interpreter (C++ "
+           "level) under jax<0.6 experimental shard_map on the CPU backend",
+)
 def test_full_3d_composition_matches_vmap(cpu_devices):
     """One federated round on the full (clients=2, seq=2, model=2) mesh —
     dp x sp(ring) x tp x ep in one jit program — must match the vmap
