@@ -111,6 +111,32 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--remat", action="store_true", default=None,
                    help="rematerialize transformer blocks (jax.checkpoint): "
                         "activation HBM ~depth -> ~1 block")
+    p.add_argument("--min-cohort-fraction", type=float, default=None,
+                   help="aggregation quorum: a round whose completed "
+                        "fraction of the cohort falls below this is an "
+                        "explicit no-op (0 disables)")
+    p.add_argument("--evict-after", type=int, default=None,
+                   help="evict a device after N consecutive failed rounds "
+                        "(>= 1)")
+    p.add_argument("--comm-retries", type=int, default=None,
+                   help="transport retries per request on transient "
+                        "failures, budgeted against the round deadline "
+                        "(0 disables)")
+    p.add_argument("--comm-backoff-base", type=float, default=None,
+                   help="retry backoff base seconds (exponential + full "
+                        "jitter)")
+    p.add_argument("--comm-backoff-max", type=float, default=None,
+                   help="retry backoff cap seconds")
+    p.add_argument("--worker-enroll-timeout", type=float, default=None,
+                   help="worker-side role-assignment window in seconds; "
+                        "expiry raises EnrollmentTimeout instead of "
+                        "hanging")
+    p.add_argument("--fault-plan", default=None,
+                   help="JSON fault-plan file (faults/plan.py) installed "
+                        "on this process's transport — deterministic "
+                        "chaos testing")
+    p.add_argument("--fault-seed", type=int, default=None,
+                   help="override the fault plan's seed")
 
 
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
@@ -120,11 +146,14 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "dp_adaptive_clip", "dp_target_quantile", "dp_clip_lr",
              "dp_bit_noise", "secure_agg", "secure_agg_neighbors",
              "straggler_prob", "compress", "aggregator", "trim_fraction",
-             "edge_groups", "edge_sync_period"}
+             "edge_groups", "edge_sync_period", "min_cohort_fraction"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _MODEL_KEYS = {"attn_impl", "remat", "stem", "norm", "width"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
-             "checkpoint_every", "profile_dir", "trace_dir", "trace_rounds"}
+             "checkpoint_every", "profile_dir", "trace_dir", "trace_rounds",
+             "evict_after", "worker_enroll_timeout", "comm_retries",
+             "comm_backoff_base", "comm_backoff_max", "fault_plan",
+             "fault_seed"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
@@ -297,6 +326,21 @@ def cmd_broker(args: argparse.Namespace) -> int:
     return 0
 
 
+def _install_fault_plan(config: ExperimentConfig) -> None:
+    """Install ``--fault-plan`` on this process's transport (chaos
+    testing).  A no-op without the flag — the transport then pays a
+    single pointer check per message."""
+    if not config.run.fault_plan:
+        return
+    from colearn_federated_learning_tpu import faults
+
+    plan = faults.FaultPlan.load(config.run.fault_plan,
+                                 seed=config.run.fault_seed or None)
+    faults.install(plan)
+    print(f"fault plan installed: {len(plan.faults)} spec(s), "
+          f"seed {plan.seed}", file=sys.stderr)
+
+
 def cmd_worker(args: argparse.Namespace) -> int:
     from colearn_federated_learning_tpu.comm.worker import run_worker_forever
 
@@ -304,6 +348,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
     if args.client_id is None:
         print("worker requires --client-id", file=sys.stderr)
         return 2
+    _install_fault_plan(config)
     mud = None
     if args.mud_profile:
         with open(args.mud_profile) as f:
@@ -333,6 +378,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
     )
 
     config = config_from_args(args)
+    _install_fault_plan(config)
     mud_policy = None
     if args.mud_require_profile or args.mud_allowed_types:
         from colearn_federated_learning_tpu.comm.mud import MudPolicy
@@ -421,6 +467,36 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
         _write_coordinator_trace(config, coord)
         print(json.dumps(hist[-1]))
     return 0
+
+
+def cmd_chaos(args: argparse.Namespace) -> int:
+    """In-process chaos soak: broker + workers + coordinator in this
+    process, a fault plan installed after the warmup round (faults/soak)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")   # soak is a CPU tool
+    except RuntimeError:
+        pass
+    from colearn_federated_learning_tpu import faults
+
+    if args.no_faults:
+        plan = None
+    elif args.fault_plan:
+        plan = faults.FaultPlan.load(args.fault_plan,
+                                     seed=args.fault_seed or None)
+    else:
+        plan = faults.canned_plan(
+            seed=args.fault_seed if args.fault_seed is not None else 7)
+    summary = faults.run_soak(
+        rounds=args.rounds, n_workers=args.num_workers, plan=plan,
+        round_timeout=args.round_timeout,
+        log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+    )
+    print(json.dumps(summary))
+    ok = (summary["rounds_run"] == args.rounds
+          and summary["weighted_acc"] is not None)
+    return 0 if ok else 1
 
 
 def cmd_trace_summary(args: argparse.Namespace) -> int:
@@ -560,6 +636,23 @@ def main(argv: list[str] | None = None) -> int:
                               "staleness-weighted mean every N updates "
                               "instead of running synchronous rounds")
     p_coord.set_defaults(fn=cmd_coordinate)
+
+    p_chaos = sub.add_parser("chaos",
+                             help="run an in-process chaos soak: a tiny "
+                                  "federation under an injected fault "
+                                  "plan, reporting recovery counters")
+    p_chaos.add_argument("--rounds", type=int, default=10)
+    p_chaos.add_argument("--num-workers", type=int, default=4)
+    p_chaos.add_argument("--round-timeout", type=float, default=6.0,
+                         help="per-round deadline for the FAULTED rounds "
+                              "(the warmup round gets a generous one)")
+    p_chaos.add_argument("--fault-plan", default=None,
+                         help="JSON fault-plan file; default is the "
+                              "canned acceptance plan (faults/soak.py)")
+    p_chaos.add_argument("--fault-seed", type=int, default=None)
+    p_chaos.add_argument("--no-faults", action="store_true",
+                         help="run the soak without any plan (baseline)")
+    p_chaos.set_defaults(fn=cmd_chaos)
 
     p_trace = sub.add_parser("trace-summary",
                              help="print a per-phase time breakdown of a "

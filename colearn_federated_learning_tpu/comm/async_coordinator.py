@@ -35,6 +35,7 @@ per-round cohort), as does adaptive clipping (cross-round engine state).
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
@@ -53,7 +54,10 @@ from colearn_federated_learning_tpu.comm.transport import TensorClient
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu import telemetry
-from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+from colearn_federated_learning_tpu.utils.config import (
+    ExperimentConfig,
+    validate_robustness,
+)
 
 
 class AsyncFederatedCoordinator:
@@ -87,7 +91,13 @@ class AsyncFederatedCoordinator:
                 "coordinator"
             )
         setup_lib.require_mean_aggregator(config, "the async coordinator")
+        validate_robustness(config)
         self.config = config
+        # Quorum, async flavor: an aggregation applied from fewer DISTINCT
+        # devices than ceil(fraction × trainers) is discarded (see
+        # run_aggregation) — a buffer filled by one fast device across
+        # versions is not a federation round.  0 disables.
+        self.min_cohort_fraction = config.fed.min_cohort_fraction
         self.buffer_size = buffer_size
         self.staleness_exponent = staleness_exponent
         self.max_staleness = max_staleness
@@ -129,7 +139,8 @@ class AsyncFederatedCoordinator:
             want_evaluator=self.want_evaluator
         )
         for d in self.trainers + ([self.evaluator] if self.evaluator else []):
-            self._clients[d.device_id] = TensorClient(d.host, d.port)
+            self._clients[d.device_id] = TensorClient(d.host, d.port,
+                                                      ident=d.device_id)
 
     def close(self) -> None:
         self._stop.set()
@@ -204,10 +215,12 @@ class AsyncFederatedCoordinator:
                 # that still needs its update.
                 try:
                     cli.close()
-                    cli = TensorClient(dev.host, dev.port)
+                    cli = TensorClient(dev.host, dev.port,
+                                       ident=dev.device_id)
                     self._clients[dev.device_id] = cli
                 except OSError:
-                    pass
+                    telemetry.get_registry().counter(
+                        "comm.reconnect_failures_total").inc()
                 self._stop.wait(0.2)
                 continue
             last_v = v
@@ -299,6 +312,20 @@ class AsyncFederatedCoordinator:
         with self.tracer.span("apply_update",
                               version=self.version) as apply_sp:
             mean_delta, total_w, mean_loss = folder.mean()
+            # Quorum over DISTINCT contributors (a slow federation can fill
+            # the buffer with one device's updates across versions).  A
+            # sub-quorum buffer is discarded — but the version still
+            # advances, or every dispatcher pump would block forever on a
+            # model that can never change.
+            quorum = (max(1, math.ceil(self.min_cohort_fraction
+                                       * len(self.trainers)))
+                      if self.min_cohort_fraction > 0 else 0)
+            skipped_quorum = bool(quorum) and len(set(contributors)) < quorum
+            if skipped_quorum:
+                telemetry.get_registry().counter(
+                    "fed.rounds_skipped_quorum").inc()
+                mean_delta = None
+                mean_loss = float("nan")
             with self._state_lock:
                 if mean_delta is not None:
                     self.server_state = strategies.server_update(
@@ -330,6 +357,10 @@ class AsyncFederatedCoordinator:
             "phase_collect_s": collect_sp.duration_s,
             "phase_apply_s": apply_sp.duration_s,
         }
+        if quorum:
+            # Key only present when the quorum feature is on, so default
+            # aggregation records stay byte-identical.
+            rec["skipped_quorum"] = skipped_quorum
         reg.histogram("async.agg_time_s").observe(rec["agg_time_s"])
         if self.accountant is not None and mean_delta is not None:
             rec["dp_z_eff"] = self._charge_privacy(weights, contributors)

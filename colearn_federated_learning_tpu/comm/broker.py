@@ -58,17 +58,29 @@ class MessageBroker:
         self._accept_thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, wake_timeout: float = 1.0) -> None:
         self._stopping.set()
-        protocol.wake_accept(self.host, self.port)
+        protocol.wake_accept(self.host, self.port, timeout=wake_timeout)
         try:
             self._srv.close()
         except OSError:
             pass
         with self._lock:
-            socks = list(self._subs)
+            # Every accepted connection (tracked by its write lock), not
+            # just the subscribed ones — a stopped broker must sever
+            # clients that connected but never subscribed too.
+            socks = set(self._subs) | set(self._wlocks)
             self._subs.clear()
+            self._wlocks.clear()
         for s in socks:
+            # shutdown BEFORE close: close() alone does not interrupt a
+            # serve thread blocked in recv (the in-flight syscall pins the
+            # kernel socket), so no FIN would reach the peer and clients
+            # could never detect the broker's death.
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:
@@ -186,6 +198,7 @@ class BrokerClient:
         self._sock = protocol.connect(host, port, timeout=timeout)
         self._sock.settimeout(None)
         self._wlock = threading.Lock()
+        self._dead = threading.Event()
         self._q: "queue.Queue[Optional[tuple[dict, bytes]]]" = queue.Queue()
         self._reader = threading.Thread(
             target=self._read_loop, name="broker-client-read", daemon=True
@@ -197,7 +210,14 @@ class BrokerClient:
             while True:
                 self._q.put(protocol.recv_msg(self._sock))
         except (protocol.ConnectionClosed, OSError, ValueError):
+            self._dead.set()
             self._q.put(None)                 # sentinel: connection is gone
+
+    def alive(self) -> bool:
+        """False once the broker connection died (the reader thread exited)
+        — the worker watchdog's restart-detection signal.  Queued messages
+        received before the death are still drainable via ``recv``."""
+        return not self._dead.is_set()
 
     def subscribe(self, topic: str, ack: bool = False) -> None:
         """``ack=True`` asks the broker to append a ``suback`` frame after

@@ -19,6 +19,7 @@ the in-tree broker + tensor transport, with three upgrades:
 from __future__ import annotations
 
 import concurrent.futures as cf
+import math
 import time
 from typing import Optional
 
@@ -31,11 +32,17 @@ from colearn_federated_learning_tpu.comm.enrollment import (
     EnrollmentManager,
 )
 from colearn_federated_learning_tpu.comm import protocol
-from colearn_federated_learning_tpu.comm.transport import TensorClient
+from colearn_federated_learning_tpu.comm.transport import (
+    RetryPolicy,
+    TensorClient,
+)
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu import telemetry
-from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+from colearn_federated_learning_tpu.utils.config import (
+    ExperimentConfig,
+    validate_robustness,
+)
 
 
 _pop_worker_spans = protocol.pop_trace_spans
@@ -70,8 +77,21 @@ class FederatedCoordinator:
                 "secure_agg_neighbors must be an even integer >= 2, got "
                 f"{config.fed.secure_agg_neighbors}"
             )
+        validate_robustness(config)
         self.round_timeout = round_timeout
         self.want_evaluator = want_evaluator
+        # Bounded retry for transient transport failures, budgeted against
+        # the shared round deadline (transport.RetryPolicy); comm_retries=0
+        # restores single-attempt behavior exactly.
+        self.retry = (
+            RetryPolicy(max_retries=config.run.comm_retries,
+                        backoff_base=config.run.comm_backoff_base,
+                        backoff_max=config.run.comm_backoff_max)
+            if config.run.comm_retries > 0 else None
+        )
+        # Aggregation quorum (fed.min_cohort_fraction): sub-quorum rounds
+        # are explicit no-ops, not two-survivor averages.  0 disables.
+        self.min_cohort_fraction = config.fed.min_cohort_fraction
         # Round spans live here; worker-side spans are adopted from reply
         # metadata so one trace covers the whole federation.  The CLI
         # writes it to RunConfig.trace_dir after fit.
@@ -86,7 +106,9 @@ class FederatedCoordinator:
         self.trainers: list[DeviceInfo] = []
         self.evaluator: Optional[DeviceInfo] = None
         self._fail_counts: dict[str, int] = {}
-        self.evict_after = 3          # consecutive failed rounds → evicted
+        # Consecutive failed rounds → evicted (RunConfig.evict_after,
+        # validated >= 1 above).
+        self.evict_after = config.run.evict_after
         self._ckpt = None
         # RDP accounting mirrors the engine's; each round is charged with
         # the ACTUAL cohort fraction and REALIZED noise (membership is
@@ -106,7 +128,8 @@ class FederatedCoordinator:
             want_evaluator=self.want_evaluator
         )
         for d in self.trainers + ([self.evaluator] if self.evaluator else []):
-            self._clients[d.device_id] = TensorClient(d.host, d.port)
+            self._clients[d.device_id] = TensorClient(d.host, d.port,
+                                                      ident=d.device_id)
 
     def close(self) -> None:
         for c in self._clients.values():
@@ -160,26 +183,41 @@ class FederatedCoordinator:
 
     def _reconnect(self, dev: DeviceInfo) -> None:
         """Replace a device's connection after a timeout: its late reply
-        would otherwise desynchronise the request/reply stream."""
+        would otherwise desynchronise the request/reply stream.  A dead
+        peer stays closed — survivable, but counted, never silent."""
         self._clients[dev.device_id].close()
         try:
-            self._clients[dev.device_id] = TensorClient(dev.host, dev.port)
+            self._clients[dev.device_id] = TensorClient(dev.host, dev.port,
+                                                        ident=dev.device_id)
         except OSError:
-            pass                                      # dead peer: keep closed
+            telemetry.get_registry().counter(
+                "comm.reconnect_failures_total").inc()
+
+    def _request(self, dev: DeviceInfo, header: dict, tree=None, meta=None,
+                 deadline=None):
+        """One device request under the coordinator's retry policy.  The
+        per-attempt timeout is whatever remains of the shared ``deadline``
+        (never more than round_timeout), so retries cannot stack past the
+        round's one budget."""
+        return self._clients[dev.device_id].request(
+            header, tree, meta=meta, timeout=self.round_timeout,
+            retry=self.retry, deadline=deadline,
+        )
 
     def _fan_out(self, devs, ask):
         """Fan ``ask`` out over ``devs`` racing ONE shared round_timeout
-        deadline (sequential per-future timeouts would stack).  Failures
-        are cancelled and the device's socket is RECONNECTED — a late
-        reply on the old socket would desynchronise the request/reply
-        stream.  Returns (results, failed_devices)."""
+        deadline (sequential per-future timeouts would stack; each ask's
+        retries are budgeted against the same deadline).  Failures are
+        cancelled and the device's socket is RECONNECTED — a late reply
+        on the old socket would desynchronise the request/reply stream.
+        Returns (results, failed_devices)."""
         results, failed = [], []
-        deadline = time.perf_counter() + self.round_timeout
+        deadline = time.monotonic() + self.round_timeout
         with cf.ThreadPoolExecutor(max_workers=max(1, len(devs))) as pool:
-            futs = {pool.submit(ask, d): d for d in devs}
+            futs = {pool.submit(ask, d, deadline): d for d in devs}
             for fut, dev in futs.items():
                 try:
-                    remaining = max(0.0, deadline - time.perf_counter())
+                    remaining = max(0.0, deadline - time.monotonic())
                     results.append(fut.result(timeout=remaining))
                 except Exception:
                     fut.cancel()
@@ -205,10 +243,16 @@ class FederatedCoordinator:
         orphaned mask halves (Bonawitz-pattern dropout recovery) before
         the aggregate is usable."""
         r = len(self.history)
+        reg = telemetry.get_registry()
+        retries_before = reg.counter("comm.retry_total").value
         with self.tracer.span("round", round=r) as round_sp:
             rec = self._run_round_traced(r)
         rec["round_time_s"] = round_sp.duration_s
-        reg = telemetry.get_registry()
+        retries = reg.counter("comm.retry_total").value - retries_before
+        if retries:
+            # Only recorded when nonzero: an idle retry layer leaves the
+            # round record byte-identical to a build without it.
+            rec["retries"] = int(retries)
         reg.counter("fed.rounds_total").inc()
         reg.counter("fed.clients_dropped").inc(len(rec["dropped"]))
         reg.counter("fed.clients_evicted").inc(len(rec["evicted"]))
@@ -226,14 +270,13 @@ class FederatedCoordinator:
         secure = self.config.fed.secure_agg
         cohort_ids = sorted(int(d.device_id) for d in cohort)
 
-        def ask(dev: DeviceInfo):
+        def ask(dev: DeviceInfo, deadline: float):
             req = protocol.attach_trace({"op": "train", "round": r}, ctx)
             if secure:
                 req["cohort"] = cohort_ids
-            header, delta = self._clients[dev.device_id].request(
-                req, params_np,
-                meta={"round": r}, timeout=self.round_timeout,
-            )
+            header, delta = self._request(dev, req, params_np,
+                                          meta={"round": r},
+                                          deadline=deadline)
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"], delta
@@ -259,8 +302,16 @@ class FederatedCoordinator:
                 received.append(int(meta["client_id"]))
             folded = folder.count
 
+            # Aggregation quorum: a sub-quorum round is an explicit no-op
+            # (the secure-agg discarded-round convention) rather than a
+            # two-survivor average passed off as progress.  0 disables.
+            quorum = (max(1, math.ceil(self.min_cohort_fraction
+                                       * len(cohort)))
+                      if self.min_cohort_fraction > 0 else 0)
+            skipped_quorum = bool(quorum) and folded < quorum
+
             unmask_failed = False
-            if secure and folded:
+            if secure and folded and not skipped_quorum:
                 missing = sorted(set(cohort_ids) - set(received))
                 if missing:
                     with self.tracer.span("unmask",
@@ -269,6 +320,11 @@ class FederatedCoordinator:
                             r, cohort_ids, received, missing, folder
                         )
             mean_delta, total_w, mean_loss = folder.mean()
+            if skipped_quorum:
+                telemetry.get_registry().counter(
+                    "fed.rounds_skipped_quorum").inc()
+                mean_delta = None
+                mean_loss = float("nan")
             if unmask_failed:
                 # Orphaned mask halves would corrupt the aggregate; a
                 # no-op round is the safe failure (same convention as
@@ -297,6 +353,10 @@ class FederatedCoordinator:
         }
         if secure:
             rec["unmask_failed"] = unmask_failed
+        if quorum:
+            # Key only present when the quorum feature is on, so default
+            # round records stay byte-identical.
+            rec["skipped_quorum"] = skipped_quorum
         if self.accountant is not None:
             # Workers calibrate per-client noise to the NOMINAL cohort
             # (fed/setup.py finalize_client_delta), so with only ``folded``
@@ -304,10 +364,10 @@ class FederatedCoordinator:
             # σ·C·sqrt(folded/nominal) — charge THAT, not nominal σ, or ε
             # under-reports whenever enrollment or completion falls short.
             # A round that released no aggregate (folded == 0, or a
-            # discarded unmask failure) costs nothing.
-            if folded > 0 and not (secure and unmask_failed):
-                import math
-
+            # discarded unmask failure, or a sub-quorum skip) costs
+            # nothing.
+            if (folded > 0 and not (secure and unmask_failed)
+                    and not skipped_quorum):
                 nominal = setup_lib.dp_effective_cohort(self.config)
                 sigma_eff = (self.config.fed.dp_noise_multiplier
                              * math.sqrt(min(folded, nominal) / nominal))
@@ -341,12 +401,13 @@ class FederatedCoordinator:
 
         ctx = self.tracer.current_context()
 
-        def ask(dev: DeviceInfo):
-            header, mask = self._clients[dev.device_id].request(
+        def ask(dev: DeviceInfo, deadline: float):
+            header, mask = self._request(
+                dev,
                 protocol.attach_trace(
                     {"op": "unmask", "round": r, "dropped": missing,
                      "cohort": cohort_ids}, ctx),
-                None, timeout=self.round_timeout,
+                deadline=deadline,
             )
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
@@ -375,10 +436,10 @@ class FederatedCoordinator:
         params_np = jax.tree.map(np.asarray, self.server_state.params)
         ctx = self.tracer.current_context()
 
-        def ask(dev: DeviceInfo):
-            header, _ = self._clients[dev.device_id].request(
-                protocol.attach_trace({"op": "self_eval"}, ctx),
-                params_np, timeout=self.round_timeout,
+        def ask(dev: DeviceInfo, deadline: float):
+            header, _ = self._request(
+                dev, protocol.attach_trace({"op": "self_eval"}, ctx),
+                params_np, deadline=deadline,
             )
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")

@@ -29,6 +29,13 @@ ENROLL_TOPIC = "colearn/enroll/"      # + device_id (retained)
 ROLE_TOPIC = "colearn/role/"          # + device_id (retained)
 
 
+class EnrollmentTimeout(TimeoutError):
+    """No coordinator assigned this device a role within the enrollment
+    window (RunConfig.worker_enroll_timeout for the CLI worker).  Distinct
+    from a generic TimeoutError so callers can tell "nobody wanted me"
+    from a slow peer mid-round."""
+
+
 @dataclasses.dataclass(frozen=True)
 class DeviceInfo:
     device_id: str
@@ -118,8 +125,19 @@ def await_role(client: BrokerClient, device_id: str,
     while True:
         remaining = None if deadline is None else deadline - time.monotonic()
         if remaining is not None and remaining <= 0:
-            raise TimeoutError(f"no role assigned to {device_id}")
-        header, _ = client.recv(timeout=remaining)
+            raise EnrollmentTimeout(
+                f"device {device_id} received no role assignment within "
+                f"{timeout:.0f}s — is a coordinator running against this "
+                "broker, and does its enrollment policy admit this device?"
+            )
+        try:
+            header, _ = client.recv(timeout=remaining)
+        except TimeoutError:
+            raise EnrollmentTimeout(
+                f"device {device_id} received no role assignment within "
+                f"{timeout:.0f}s — is a coordinator running against this "
+                "broker, and does its enrollment policy admit this device?"
+            ) from None
         if header.get("topic") == ROLE_TOPIC + device_id:
             return header["role"]
 
@@ -282,7 +300,8 @@ def admit_late_joiners(enroll: "EnrollmentManager", broker, trainers: list,
         if d.device_id in known:
             continue
         try:
-            clients[d.device_id] = TensorClient(d.host, d.port)
+            clients[d.device_id] = TensorClient(d.host, d.port,
+                                                ident=d.device_id)
         except OSError:
             continue
         broker.publish(ROLE_TOPIC + d.device_id,

@@ -1,10 +1,16 @@
-"""Wire framing: [4B header-len][JSON header][8B body-len][body bytes].
+"""Wire framing: [4B header-len][JSON header][8B body-len][4B crc32][body].
 
 One frame carries a JSON control header (msg type, topic, round index, …)
 plus an optional opaque body (serialized model pytree — see
 utils/serialization.py).  Used by both the pub/sub broker (control plane)
 and the tensor transport (data plane); the reference's equivalent split is
 MQTT JSON payloads + pickled-PySyft-tensor websocket frames.
+
+Every frame carries a CRC32 over header+body, so a corrupted frame is a
+:class:`CorruptFrame` at the receiver — classified per-connection (one
+device's bad frame drops that device, never the coordinator) instead of
+surfacing as a JSON decode error or, worse, silently folding garbage
+bytes into an aggregate.
 """
 
 from __future__ import annotations
@@ -12,12 +18,13 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import zlib
 from typing import Optional
 
 from colearn_federated_learning_tpu.telemetry import registry as _metrics
 
 _HDR = struct.Struct(">I")     # header length
-_BODY = struct.Struct(">Q")    # body length
+_BODY = struct.Struct(">QI")   # body length, crc32(header bytes + body)
 MAX_HEADER = 1 << 20           # 1 MiB of JSON is already absurd
 MAX_BODY = 1 << 34             # 16 GiB
 
@@ -64,6 +71,15 @@ class ConnectionClosed(Exception):
     """Peer closed the socket mid-frame (or before one started)."""
 
 
+class CorruptFrame(ValueError):
+    """Frame failed an integrity check (length sanity or CRC32 mismatch).
+
+    Subclasses ``ValueError`` so every existing per-connection handler
+    (TensorServer._serve, broker loops) already treats it as that one
+    peer's failure; the stream is unrecoverable past this point, so the
+    connection must be dropped, not re-read."""
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -74,11 +90,21 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def frame_crc(hdr: bytes, body: bytes) -> int:
+    return zlib.crc32(body, zlib.crc32(hdr))
+
+
+def _corrupt(msg: str) -> CorruptFrame:
+    _metrics.get_registry().counter("comm.corrupt_frames_total").inc()
+    return CorruptFrame(f"corrupt frame: {msg}")
+
+
 def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
     hdr = json.dumps(header, separators=(",", ":")).encode()
     if len(hdr) > MAX_HEADER:
         raise ValueError(f"header too large: {len(hdr)}")
-    sock.sendall(_HDR.pack(len(hdr)) + hdr + _BODY.pack(len(body)))
+    sock.sendall(_HDR.pack(len(hdr)) + hdr
+                 + _BODY.pack(len(body), frame_crc(hdr, body)))
     if body:
         sock.sendall(body)
     reg = _metrics.get_registry()
@@ -91,12 +117,19 @@ def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
 def recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
     (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if hlen > MAX_HEADER:
-        raise ValueError(f"corrupt frame: header length {hlen}")
-    header = json.loads(_recv_exact(sock, hlen).decode())
-    (blen,) = _BODY.unpack(_recv_exact(sock, _BODY.size))
+        raise _corrupt(f"header length {hlen}")
+    hdr = _recv_exact(sock, hlen)
+    (blen, crc) = _BODY.unpack(_recv_exact(sock, _BODY.size))
     if blen > MAX_BODY:
-        raise ValueError(f"corrupt frame: body length {blen}")
+        raise _corrupt(f"body length {blen}")
     body = _recv_exact(sock, blen) if blen else b""
+    if frame_crc(hdr, body) != crc:
+        raise _corrupt("crc32 mismatch")
+    try:
+        header = json.loads(hdr.decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        # CRC passed but the header is not JSON: a buggy (not flaky) peer.
+        raise _corrupt(f"undecodable header ({e})") from None
     reg = _metrics.get_registry()
     reg.counter("comm.messages_received").inc()
     reg.counter("comm.bytes_received").inc(
@@ -111,17 +144,21 @@ def connect(host: str, port: int, timeout: Optional[float] = None) -> socket.soc
     return sock
 
 
-def wake_accept(host: str, port: int) -> None:
+def wake_accept(host: str, port: int, timeout: float = 1.0) -> None:
     """Unblock a thread stuck in ``accept(2)`` on (host, port).
 
     On Linux, closing a listening socket from another thread does NOT
     interrupt an in-progress accept syscall (the kernel holds the file
     reference until it returns), which would leave the LISTEN socket
     alive and the port occupied.  A throwaway connection forces accept to
-    return; callers set their stop flag FIRST so the accept loop exits.
-    Shared by MessageBroker.stop and TensorServer.stop."""
+    return; callers set their stop flag FIRST so the accept loop exits,
+    and pass their own shutdown ``timeout`` budget.  Shared by
+    MessageBroker.stop and TensorServer.stop.  A failed wake connect is
+    survivable (the listener may already be gone) but never silent: it is
+    counted in ``comm.suppressed_oserrors_total``."""
     try:
-        wake = socket.create_connection((host, port), timeout=1.0)
+        wake = socket.create_connection((host, port), timeout=timeout)
         wake.close()
     except OSError:
-        pass
+        _metrics.get_registry().counter(
+            "comm.suppressed_oserrors_total").inc()

@@ -7,15 +7,35 @@ A device hosts a ``TensorServer`` whose handler maps
 ``TensorClient`` does one round trip per request.  Payloads are
 utils/serialization.py npz bytes — the same format the offline file flow
 writes, so wire and file federation are interchangeable.
+
+Robustness seams (faults/ exercises both, production pays for neither
+when they are off):
+
+- an optional process-wide :class:`TransportInterposer` is consulted at
+  each request/reply boundary — the fault-injection hook (install one via
+  :func:`install_interposer`; ``None``, the default, is a single pointer
+  check per message);
+- ``TensorClient.request`` takes an optional :class:`RetryPolicy` plus a
+  shared ``deadline``: transient failures (reset connections, corrupt
+  frames) are retried on a FRESH socket with exponential backoff + full
+  jitter, and every attempt is budgeted against the deadline so retries
+  can never stack past the round's one timeout.  Peer timeouts are NOT
+  retried — a peer that consumed the whole budget is a straggler, and
+  re-asking cannot finish any sooner.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import random
 import socket
 import threading
+import time
+import zlib
 from typing import Any, Callable, Optional
 
 from colearn_federated_learning_tpu.comm import protocol
+from colearn_federated_learning_tpu.telemetry import registry as _metrics
 from colearn_federated_learning_tpu.utils.serialization import (
     bytes_to_pytree,
     pytree_to_bytes,
@@ -24,14 +44,73 @@ from colearn_federated_learning_tpu.utils.serialization import (
 Handler = Callable[[dict, Any], tuple[dict, Any]]
 
 
+class SkipRequest(Exception):
+    """Raised by an interposer to make the server silently discard the
+    current request — no reply, connection kept open.  The client-side
+    symptom is a request timeout, exactly like a lost datagram."""
+
+
+class TransportInterposer:
+    """Hook points the transport consults when one is installed.
+
+    The base class is a no-op; faults.FaultInjector overrides these to
+    inject deterministic failures.  Hooks communicate through ordinary
+    transport exceptions (``protocol.ConnectionClosed``, ``OSError``,
+    :class:`SkipRequest`) or by writing to/closing the socket themselves,
+    so the transport needs no fault-specific control flow."""
+
+    def server_request(self, server: "TensorServer", conn: socket.socket,
+                       header: dict) -> None:
+        """After a request frame is received, before the handler runs."""
+
+    def server_reply(self, server: "TensorServer", conn: socket.socket,
+                     header: dict) -> None:
+        """Before the reply frame is sent; ``header`` is the REQUEST's."""
+
+    def client_request(self, client: "TensorClient", header: dict) -> None:
+        """Before the client sends a request frame."""
+
+
+_interposer: Optional[TransportInterposer] = None
+
+
+def install_interposer(obj: Optional[TransportInterposer]) -> None:
+    """Install (or with ``None`` remove) the process-wide interposer."""
+    global _interposer
+    _interposer = obj
+
+
+def current_interposer() -> Optional[TransportInterposer]:
+    return _interposer
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff + full jitter (the AWS
+    "full jitter" schedule: sleep ~ U(0, min(max, base·2^attempt))).
+    ``max_retries`` counts RE-tries — 0 disables retrying entirely."""
+
+    max_retries: int = 2
+    backoff_base: float = 0.05
+    backoff_max: float = 2.0
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        cap = min(self.backoff_max, self.backoff_base * (2.0 ** attempt))
+        return rng.uniform(0.0, cap)
+
+
 class TensorServer:
     """Serve ``handler`` on a TCP port (``port=0`` → ephemeral, see
     ``.port``).  One thread per connection; connections may issue many
-    requests (the coordinator keeps one open across rounds)."""
+    requests (the coordinator keeps one open across rounds).
+
+    ``ident`` names the hosted device (the worker's client id) so an
+    installed interposer can key faults by ``(device_id, round, op)``."""
 
     def __init__(self, handler: Handler, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, ident: str = ""):
         self._handler = handler
+        self.ident = ident
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -46,18 +125,22 @@ class TensorServer:
                          daemon=True).start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, wake_timeout: float = 1.0) -> None:
         """Stop accepting AND sever live connections — a stopped server
         must actually disappear from the federation, not linger on
-        already-open sockets."""
+        already-open sockets.  Close errors are survivable (the peer may
+        have dropped first) but never silent: each is counted in
+        ``comm.suppressed_oserrors_total``."""
+        suppressed = _metrics.get_registry().counter(
+            "comm.suppressed_oserrors_total")
         self._stopping.set()
         # A worker restarting on its own port must be able to rebind:
         # wake the blocked accept before closing (protocol.wake_accept).
-        protocol.wake_accept(self.host, self.port)
+        protocol.wake_accept(self.host, self.port, timeout=wake_timeout)
         try:
             self._srv.close()
         except OSError:
-            pass
+            suppressed.inc()
         with self._conns_lock:
             conns = list(self._conns)
             self._conns.clear()
@@ -65,11 +148,11 @@ class TensorServer:
             try:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:
-                pass
+                suppressed.inc()
             try:
                 c.close()
             except OSError:
-                pass
+                suppressed.inc()
 
     def __enter__(self):
         return self.start()
@@ -101,6 +184,12 @@ class TensorServer:
         try:
             while True:
                 header, body = protocol.recv_msg(conn)
+                ip = _interposer
+                try:
+                    if ip is not None:
+                        ip.server_request(self, conn, header)
+                except SkipRequest:
+                    continue              # request "lost": no reply at all
                 tree, meta = bytes_to_pytree(body) if body else (None, {})
                 header.setdefault("meta", meta)
                 try:
@@ -113,6 +202,8 @@ class TensorServer:
                     if out_tree is not None else b""
                 )
                 out_header.setdefault("status", "ok")
+                if ip is not None:
+                    ip.server_reply(self, conn, header)
                 protocol.send_msg(conn, out_header, out_body)
         except (protocol.ConnectionClosed, OSError, ValueError):
             pass
@@ -125,21 +216,89 @@ class TensorServer:
                 pass
 
 
-class TensorClient:
-    """Coordinator-side connection to one device's TensorServer."""
+# Failure classes a retry can actually fix: the peer is (or may be) alive
+# but THIS exchange died — reset/refused connections, a mid-frame close,
+# a corrupt frame.  TimeoutError (a subclass of OSError since 3.10) is
+# excluded by an explicit re-raise in the retry loop.
+_RETRYABLE = (protocol.ConnectionClosed, protocol.CorruptFrame, OSError)
 
-    def __init__(self, host: str, port: int, timeout: Optional[float] = None):
+
+class TensorClient:
+    """Coordinator-side connection to one device's TensorServer.
+
+    ``ident`` names the PEER device; it keys interposer faults and seeds
+    this client's deterministic retry jitter."""
+
+    def __init__(self, host: str, port: int, timeout: Optional[float] = None,
+                 ident: str = ""):
+        self._host, self._port = host, port
+        self.ident = ident or f"{host}:{port}"
+        self._rng = random.Random(zlib.crc32(self.ident.encode()))
         self._sock = protocol.connect(host, port, timeout=timeout)
+
+    def _reconnect(self, timeout: Optional[float]) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            _metrics.get_registry().counter(
+                "comm.suppressed_oserrors_total").inc()
+        self._sock = protocol.connect(self._host, self._port, timeout=timeout)
 
     def request(self, header: dict, tree: Any = None,
                 meta: Optional[dict] = None,
-                timeout: Optional[float] = None) -> tuple[dict, Any]:
+                timeout: Optional[float] = None,
+                retry: Optional[RetryPolicy] = None,
+                deadline: Optional[float] = None) -> tuple[dict, Any]:
         """One round trip.  Raises ``TimeoutError``/``OSError`` on a dead or
-        too-slow peer — the coordinator treats that as a straggler drop."""
-        self._sock.settimeout(timeout)
+        too-slow peer — the coordinator treats that as a straggler drop.
+
+        With ``retry``, transient transport failures are retried on a
+        fresh socket (a failed socket may hold a late half-frame that
+        would desynchronise the stream).  ``deadline`` is an absolute
+        ``time.monotonic()`` instant shared by every attempt AND backoff
+        sleep, so retrying never extends the caller's one budget."""
         body = pytree_to_bytes(tree, meta) if tree is not None else b""
-        protocol.send_msg(self._sock, header, body)
-        out_header, out_body = protocol.recv_msg(self._sock)
+        attempts = 1 + (retry.max_retries if retry is not None else 0)
+        retries = _metrics.get_registry().counter("comm.retry_total")
+        for attempt in range(attempts):
+            attempt_timeout = timeout
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"{self.ident}: round deadline exhausted before "
+                        f"attempt {attempt + 1}"
+                    )
+                attempt_timeout = (remaining if attempt_timeout is None
+                                   else min(attempt_timeout, remaining))
+            try:
+                ip = _interposer
+                if ip is not None:
+                    ip.client_request(self, header)
+                self._sock.settimeout(attempt_timeout)
+                protocol.send_msg(self._sock, header, body)
+                out_header, out_body = protocol.recv_msg(self._sock)
+                break
+            except TimeoutError:
+                raise                    # straggler: retrying cannot help
+            except _RETRYABLE:
+                if attempt + 1 >= attempts:
+                    raise
+                retries.inc()
+                delay = retry.delay(attempt, self._rng)
+                if deadline is not None:
+                    delay = min(delay, max(0.0, deadline - time.monotonic()))
+                if delay > 0:
+                    time.sleep(delay)
+                # Reconnect may itself fail (peer rebooting): that is the
+                # next attempt's failure, charged against the same budget.
+                try:
+                    self._reconnect(attempt_timeout)
+                except TimeoutError:
+                    raise
+                except _RETRYABLE:
+                    if attempt + 2 >= attempts:
+                        raise
         out_tree, out_meta = bytes_to_pytree(out_body) if out_body else (None, {})
         out_header.setdefault("meta", out_meta)
         return out_header, out_tree

@@ -134,11 +134,14 @@ class DeviceWorker:
         # its trace via the propagated trace id.
         self.tracer = Tracer(process=f"worker-{self.client_id}",
                              enabled=False)
-        self._server = TensorServer(self._handle, host=host, port=port)
+        self._server = TensorServer(self._handle, host=host, port=port,
+                                    ident=str(self.client_id))
         self._broker: Optional[BrokerClient] = None
         self._broker_addr = (broker_host, broker_port)
         self._mud_profile = mud_profile or ""
         self.role: Optional[str] = None
+        self._watch_stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
     @property
@@ -150,27 +153,75 @@ class DeviceWorker:
         return self._server.host
 
     def start(self) -> "DeviceWorker":
-        """Start serving; if a broker address was given, enroll there."""
+        """Start serving; if a broker address was given, enroll there and
+        start the re-enrollment watchdog (a restarted broker loses this
+        device's retained enrollment — the watchdog reconnects and
+        re-announces so the federation heals without operator action)."""
         self._server.start()
         bh, bp = self._broker_addr
         if bh is not None:
             self._broker = BrokerClient(bh, bp)
-            # Subscribe to our role topic BEFORE announcing (no race).
-            self._broker.subscribe(
-                enrollment.ROLE_TOPIC + str(self.client_id)
+            self._announce(self._broker)
+            self._watchdog = threading.Thread(
+                target=self._watch_broker,
+                name=f"worker-{self.client_id}-watchdog", daemon=True,
             )
+            self._watchdog.start()
+        return self
+
+    def _announce(self, broker: BrokerClient) -> None:
+        """Subscribe to our role topic BEFORE announcing (no race)."""
+        broker.subscribe(enrollment.ROLE_TOPIC + str(self.client_id))
+        pubkey = ""
+        if self._dh_mode:
             from colearn_federated_learning_tpu.comm import keyexchange
 
-            enrollment.announce(self._broker, enrollment.DeviceInfo(
-                device_id=str(self.client_id),
-                host=self.host, port=self.port,
-                num_examples=self.num_examples,
-                dataset=self.config.data.dataset,
-                pubkey=(keyexchange.encode_public(self._dh_pub)
-                        if self._dh_mode else ""),
-                mud=self._mud_profile,
-            ))
-        return self
+            pubkey = keyexchange.encode_public(self._dh_pub)
+        enrollment.announce(broker, enrollment.DeviceInfo(
+            device_id=str(self.client_id),
+            host=self.host, port=self.port,
+            num_examples=self.num_examples,
+            dataset=self.config.data.dataset,
+            pubkey=pubkey,
+            mud=self._mud_profile,
+        ))
+
+    def _watch_broker(self, poll: float = 0.5) -> None:
+        """Auto re-enrollment: when the broker connection dies (broker or
+        coordinator host restarted), reconnect with backoff and re-announce
+        — the retained enrollment record died with the old broker, so
+        without this the device would be invisible to the next
+        coordinator.  Each successful recovery is counted in
+        ``comm.reenroll_total``."""
+        from colearn_federated_learning_tpu import telemetry
+
+        bh, bp = self._broker_addr
+        backoff = poll
+        while not self._watch_stop.wait(poll):
+            broker = self._broker
+            if broker is None or broker.alive():
+                backoff = poll
+                continue
+            try:
+                fresh = BrokerClient(bh, bp)
+            except OSError:
+                # Broker still down: back off (capped) and keep trying.
+                if self._watch_stop.wait(backoff):
+                    return
+                backoff = min(5.0, backoff * 2.0)
+                continue
+            broker.close()
+            self._broker = fresh
+            if getattr(self, "_dh_mode", False):
+                # The dedicated DH lookup connection died with the broker;
+                # drop it so the next train request rebuilds it fresh.
+                with self._dh_lock:
+                    if self._dh_lookup is not None:
+                        self._dh_lookup.close()
+                        self._dh_lookup = None
+            self._announce(fresh)
+            telemetry.get_registry().counter("comm.reenroll_total").inc()
+            backoff = poll
 
     def await_role(self, timeout: float = 30.0) -> str:
         if self._broker is None:
@@ -181,6 +232,11 @@ class DeviceWorker:
         return self.role
 
     def stop(self) -> None:
+        # Stop the watchdog FIRST: our own broker close must not read as a
+        # broker death and trigger a pointless re-enrollment.
+        self._watch_stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
         self._server.stop()
         if self._broker is not None:
             self._broker.close()
@@ -458,11 +514,13 @@ class DeviceWorker:
 def run_worker_forever(config: ExperimentConfig, client_id: int,
                        broker_host: str, broker_port: int,
                        mud_profile: Optional[str] = None) -> None:
-    """CLI entry: serve until the process is killed."""
+    """CLI entry: serve until the process is killed.  The enrollment
+    window is ``config.run.worker_enroll_timeout``; expiry raises
+    :class:`enrollment.EnrollmentTimeout` instead of hanging forever."""
     worker = DeviceWorker(config, client_id, broker_host, broker_port,
                           mud_profile=mud_profile).start()
     try:
-        worker.await_role(timeout=3600.0)
+        worker.await_role(timeout=config.run.worker_enroll_timeout)
         threading.Event().wait()      # serve forever
     finally:
         worker.stop()

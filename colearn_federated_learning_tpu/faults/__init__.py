@@ -1,0 +1,41 @@
+"""Deterministic fault injection for the federation comm plane.
+
+``plan`` describes WHAT fails (a seeded schedule keyed by
+``(device_id, round, op)``), ``inject`` applies it at the transport
+interposer seams, and ``soak`` runs an in-process federation under a plan
+and reports whether the robustness machinery (retries, quorum, eviction,
+CRC framing) actually held.  Production code never imports this package —
+comm/transport.py only exposes the seams.
+"""
+
+from colearn_federated_learning_tpu.faults.plan import (
+    ANY,
+    ANY_ROUND,
+    KINDS,
+    FaultPlan,
+    FaultSpec,
+)
+from colearn_federated_learning_tpu.faults.inject import (
+    FaultInjector,
+    install,
+    uninstall,
+)
+from colearn_federated_learning_tpu.faults.soak import (
+    canned_plan,
+    default_soak_config,
+    run_soak,
+)
+
+__all__ = [
+    "ANY",
+    "ANY_ROUND",
+    "KINDS",
+    "FaultPlan",
+    "FaultSpec",
+    "FaultInjector",
+    "install",
+    "uninstall",
+    "canned_plan",
+    "default_soak_config",
+    "run_soak",
+]

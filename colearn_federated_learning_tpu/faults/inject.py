@@ -1,0 +1,113 @@
+"""Transport interposer that applies a :class:`~.plan.FaultPlan`.
+
+The injector is the only piece that knows how to express each fault kind
+through the generic transport seams (comm/transport.py hook points +
+ordinary transport exceptions), so production transport code carries no
+fault-specific control flow:
+
+- ``delay``            — sleep ``ms`` before the handler runs;
+- ``drop_request``     — raise ``SkipRequest``: the request is silently
+                         discarded, the client times out (a lost packet);
+- ``flap_reconnect``   — raise ``ConnectionClosed``: the server severs
+                         the connection pre-reply (a reset), the client's
+                         retry path reconnects;
+- ``corrupt_payload``  — write a frame with a deliberately wrong CRC32 in
+                         place of the reply, then sever: the client's
+                         ``recv_msg`` raises ``CorruptFrame``;
+- ``crash_worker``     — stop the device's whole TensorServer: every
+                         later request sees a dead peer until the worker
+                         is restarted (mid-run crash).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from colearn_federated_learning_tpu.comm import protocol, transport
+from colearn_federated_learning_tpu.faults.plan import FaultPlan
+from colearn_federated_learning_tpu.telemetry import registry as _metrics
+
+_REQUEST_KINDS = ("delay", "drop_request", "flap_reconnect", "crash_worker")
+_REPLY_KINDS = ("corrupt_payload",)
+
+
+def _key(header: dict) -> tuple[int, str]:
+    rnd = header.get("round")
+    return (None if rnd is None else int(rnd)), str(header.get("op", ""))
+
+
+def _count(kind: str) -> None:
+    reg = _metrics.get_registry()
+    reg.counter("fault.injected_total").inc()
+    reg.counter(f"fault.injected.{kind}").inc()
+
+
+def send_corrupt_frame(sock: socket.socket) -> None:
+    """Emit a frame whose CRC32 cannot match its contents — what a flaky
+    NIC/path would deliver.  Lengths stay sane so the receiver reads the
+    whole frame and fails the integrity check, not the length sanity
+    check."""
+    hdr = b'{"status":"ok"}'
+    body = b"\x00corrupted\x00"
+    crc = protocol.frame_crc(hdr, body) ^ 0xDEADBEEF
+    sock.sendall(protocol._HDR.pack(len(hdr)) + hdr
+                 + protocol._BODY.pack(len(body), crc) + body)
+
+
+class FaultInjector(transport.TransportInterposer):
+    """Apply ``plan`` at the transport seams (see module docstring)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def _apply(self, fault, server, conn) -> None:
+        _count(fault.kind)
+        if fault.kind == "delay":
+            time.sleep(fault.ms / 1000.0)
+        elif fault.kind == "drop_request":
+            raise transport.SkipRequest(f"injected drop ({fault})")
+        elif fault.kind == "flap_reconnect":
+            raise protocol.ConnectionClosed(f"injected flap ({fault})")
+        elif fault.kind == "crash_worker":
+            if server is not None:
+                server.stop()
+            raise protocol.ConnectionClosed(f"injected crash ({fault})")
+
+    # ------------------------------------------------- transport hooks --
+    def server_request(self, server, conn, header) -> None:
+        rnd, op = _key(header)
+        for f in self.plan.match(server.ident, rnd, op,
+                                 kinds=_REQUEST_KINDS, site="server"):
+            self._apply(f, server, conn)
+
+    def server_reply(self, server, conn, header) -> None:
+        rnd, op = _key(header)
+        for f in self.plan.match(server.ident, rnd, op,
+                                 kinds=_REPLY_KINDS, site="server"):
+            _count(f.kind)
+            send_corrupt_frame(conn)
+            raise protocol.ConnectionClosed(f"injected corruption ({f})")
+
+    def client_request(self, client, header) -> None:
+        rnd, op = _key(header)
+        for f in self.plan.match(client.ident, rnd, op,
+                                 kinds=("delay", "flap_reconnect"),
+                                 site="client"):
+            _count(f.kind)
+            if f.kind == "delay":
+                time.sleep(f.ms / 1000.0)
+            else:
+                raise protocol.ConnectionClosed(f"injected flap ({f})")
+
+
+def install(plan: FaultPlan) -> FaultInjector:
+    """Install ``plan`` process-wide; returns the injector (its ``plan``
+    keeps the firing ledger).  Call :func:`uninstall` when done."""
+    injector = FaultInjector(plan)
+    transport.install_interposer(injector)
+    return injector
+
+
+def uninstall() -> None:
+    transport.install_interposer(None)

@@ -1,0 +1,195 @@
+"""Chaos soak: an in-process federation run under a fault plan.
+
+One broker, ``n_workers`` DeviceWorkers and a FederatedCoordinator run in
+this process; after a fault-free warmup round (the first train request
+compiles each worker's jit program — a plan must perturb steady-state
+rounds, not compile time) the plan is installed and the remaining rounds
+run against injected drops, delays, corrupt frames and crashes.  The
+returned summary carries every round record plus the telemetry counter
+deltas, so a caller (scripts/chaos_soak.py, tests/test_chaos_soak.py) can
+assert the robustness machinery held rather than eyeball a log.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.faults import inject
+from colearn_federated_learning_tpu.faults.plan import FaultPlan, FaultSpec
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+# Counters whose soak-window delta the summary reports.
+_COUNTERS = (
+    "comm.retry_total",
+    "comm.corrupt_frames_total",
+    "comm.reconnect_failures_total",
+    "fault.injected_total",
+    "fed.rounds_skipped_quorum",
+)
+
+
+def default_soak_config(n_workers: int = 4, seed: int = 0,
+                        min_cohort_fraction: float = 0.5,
+                        evict_after: int = 2,
+                        comm_retries: int = 2) -> ExperimentConfig:
+    """Tiny CPU federation with the robustness features ON: quorum at
+    half the cohort, eviction after 2 straight failures, 2 retries.
+
+    Plain SGD (no momentum) at a calm lr: the verdict compares final
+    accuracy between a faulted and a fault-free run, so the optimizer
+    must converge monotonically — with momentum 0.9 at lr 0.1 the
+    trajectory oscillates and "fewer updates" can land on a BETTER
+    point, inverting the comparison."""
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=n_workers,
+                        partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32,
+                          depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=10, cohort_size=0,
+                      local_steps=4, batch_size=16, lr=0.05, momentum=0.0,
+                      min_cohort_fraction=min_cohort_fraction),
+        run=RunConfig(name="chaos_soak", backend="cpu", seed=seed,
+                      evict_after=evict_after, comm_retries=comm_retries),
+    )
+
+
+def canned_plan(seed: int = 7) -> FaultPlan:
+    """The acceptance-criteria plan against the default 4-worker soak
+    (rounds are post-warmup: warmup is round 0, faults start at 1):
+
+    - round 1: a delayed and a twice-flapped trainer — both recover
+      within the round via the retry path;
+    - round 2: three parallel request drops — only one survivor, below
+      the 50% quorum, so the round must be an explicit no-op;
+    - round 3: one corrupt reply frame — CRC failure, retried, recovered;
+    - round 4: one mid-run worker crash — the device drops this round and
+      every later one, and is evicted after ``evict_after`` failures.
+
+    Rounds 5+ are fault-free: the surviving cohort gets a recovery tail
+    long enough for final accuracy to re-converge toward the baseline's.
+    """
+    return FaultPlan([
+        FaultSpec(kind="delay", device_id="0", round=1, op="train", ms=150),
+        FaultSpec(kind="flap_reconnect", device_id="1", round=1, op="train",
+                  count=2),
+        FaultSpec(kind="drop_request", device_id="0", round=2, op="train"),
+        FaultSpec(kind="drop_request", device_id="1", round=2, op="train"),
+        FaultSpec(kind="drop_request", device_id="2", round=2, op="train"),
+        FaultSpec(kind="corrupt_payload", device_id="1", round=3,
+                  op="train"),
+        FaultSpec(kind="crash_worker", device_id="3", round=4, op="train"),
+    ], seed=seed)
+
+
+def run_soak(rounds: int = 10, n_workers: int = 4,
+             plan: Optional[FaultPlan] = None,
+             round_timeout: float = 6.0,
+             warmup_timeout: float = 120.0,
+             config: Optional[ExperimentConfig] = None,
+             log_fn: Optional[Callable[[dict], None]] = None) -> dict:
+    """Run ``rounds`` federated rounds (1 fault-free warmup + the rest
+    under ``plan``) and return a summary dict: ``records`` (every round
+    record, in order), ``skipped_rounds``, ``evicted``, per-counter
+    deltas under ``counters``, the plan's ``faults_fired`` ledger, and a
+    fault-free final ``weighted_acc``/``weighted_loss`` over the
+    surviving trainers' own shards."""
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+    from colearn_federated_learning_tpu.comm.coordinator import (
+        FederatedCoordinator,
+    )
+    from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+
+    if rounds < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    config = config or default_soak_config(n_workers)
+    reg = telemetry.get_registry()
+    before = {name: reg.counter(name).value for name in _COUNTERS}
+
+    broker = MessageBroker().start()
+    workers = []
+    coord = None
+    installed = False
+    try:
+        workers = [
+            DeviceWorker(config, i, broker.host, broker.port).start()
+            for i in range(n_workers)
+        ]
+        coord = FederatedCoordinator(config, broker.host, broker.port,
+                                     round_timeout=warmup_timeout,
+                                     want_evaluator=False)
+        coord.enroll(min_devices=n_workers, timeout=30.0)
+        # Announcement arrival order is a thread race; aggregation folds
+        # in trainer order, so sort for run-to-run byte-identical records.
+        coord.trainers.sort(key=lambda d: int(d.device_id))
+        for w in workers:
+            w.await_role(timeout=10.0)
+
+        rec = coord.run_round()                      # warmup (round 0)
+        if log_fn is not None:
+            log_fn(rec)
+        coord.round_timeout = round_timeout
+        if plan is not None:
+            inject.install(plan)
+            installed = True
+        for _ in range(rounds - 1):
+            rec = coord.run_round()
+            if log_fn is not None:
+                log_fn(rec)
+        if installed:
+            inject.uninstall()
+            installed = False
+        # Scored AFTER uninstall: the verdict metric must measure what
+        # the faults did to the MODEL, not be corrupted by them.  Back on
+        # the generous deadline — the first self_eval compiles.
+        coord.round_timeout = warmup_timeout
+        per_client = coord.evaluate_per_client()
+    finally:
+        if installed:
+            inject.uninstall()
+        for w in workers:
+            w.stop()
+        broker.stop()
+        if coord is not None:
+            coord.close()
+
+    records = list(coord.history)
+    return {
+        "rounds_run": len(records),
+        "records": records,
+        "completed_rounds": [r["round"] for r in records
+                             if r["completed"] > 0
+                             and not r.get("skipped_quorum")],
+        "skipped_rounds": [r["round"] for r in records
+                           if r.get("skipped_quorum")],
+        "evicted": sorted({d for r in records for d in r["evicted"]}),
+        "weighted_acc": per_client.get("weighted_acc"),
+        "weighted_loss": per_client.get("weighted_loss"),
+        # device_id -> final own-shard accuracy.  Verdicts that compare a
+        # faulted run against a baseline must intersect on the devices
+        # BOTH runs still have (eviction shrinks the faulted eval set).
+        "per_client_acc": per_client.get("per_client", {}),
+        "counters": {
+            name: reg.counter(name).value - before[name]
+            for name in _COUNTERS
+        },
+        "faults_fired": dict(plan.fired) if plan is not None else {},
+    }
+
+
+# Timing keys vary run to run; everything else in a round record must be
+# byte-identical between a no-plan run and an empty-plan run (the
+# fault layer's zero-cost-when-disabled contract, tests/test_chaos_soak).
+_TIMING_KEYS = ("round_time_s",)
+
+
+def strip_timing(rec: dict) -> dict:
+    """A round record minus wall-clock fields — the byte-comparison view."""
+    return {k: v for k, v in rec.items()
+            if k not in _TIMING_KEYS and not k.startswith("phase_")}

@@ -45,6 +45,32 @@ def validate_experiment(config: "ExperimentConfig") -> None:
         )
 
 
+def validate_robustness(config: "ExperimentConfig") -> None:
+    """Hard checks on the comm-plane robustness knobs.  These RAISE
+    (unlike :func:`validate_experiment`'s perf warnings): a quorum above
+    1.0 or an eviction threshold of 0 is not a slow configuration, it is
+    a meaningless one.  Called by both socket coordinators and the worker
+    entrypoints."""
+    run, fed = config.run, config.fed
+    if run.evict_after < 1:
+        raise ValueError(f"evict_after must be >= 1, got {run.evict_after}")
+    if not 0.0 <= fed.min_cohort_fraction <= 1.0:
+        raise ValueError(
+            "min_cohort_fraction must be in [0, 1], got "
+            f"{fed.min_cohort_fraction}"
+        )
+    if run.comm_retries < 0:
+        raise ValueError(
+            f"comm_retries must be >= 0, got {run.comm_retries}")
+    if run.comm_backoff_base < 0 or run.comm_backoff_max < 0:
+        raise ValueError("comm backoff values must be >= 0")
+    if run.worker_enroll_timeout <= 0:
+        raise ValueError(
+            "worker_enroll_timeout must be positive, got "
+            f"{run.worker_enroll_timeout}"
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
     dataset: str = "mnist"            # registry name (data/registry.py)
@@ -149,6 +175,12 @@ class FedConfig:
     secure_agg_key_exchange: str = "dh"   # dh | shared_seed
     # Update compression on the wire/file planes (fed/compression.py).
     compress: str = "none"            # none | int8 | topk
+    # Aggregation quorum for the socket coordinators: a round whose
+    # completed-update count falls below ceil(fraction * cohort) becomes
+    # an explicit no-op (the secure-agg discarded-round convention)
+    # instead of silently averaging a couple of survivors.  0 disables —
+    # today's behavior, and the default.
+    min_cohort_fraction: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,6 +199,16 @@ class RunConfig:
     profile_dir: Optional[str] = None  # jax.profiler trace output (rounds 1-2)
     trace_dir: Optional[str] = None    # span-trace Chrome JSON output dir
     trace_rounds: int = 0              # trace only the first N rounds (0 = all)
+    # --- comm-plane robustness (comm/coordinator.py, comm/worker.py) ----
+    evict_after: int = 3               # consecutive failed rounds → evicted
+    worker_enroll_timeout: float = 3600.0  # worker await_role budget (s)
+    comm_retries: int = 2              # transient-failure retries per request
+    comm_backoff_base: float = 0.05    # full-jitter backoff base (s)
+    comm_backoff_max: float = 2.0      # backoff cap (s)
+    # Deterministic fault injection (faults/): path to a FaultPlan JSON
+    # installed as the transport interposer; None = no fault layer at all.
+    fault_plan: Optional[str] = None
+    fault_seed: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,83 @@
+"""Chaos soak regression gate (tier 1, CPU, deterministic).
+
+Runs the canned fault plan (drops + delays + one corrupt frame + one
+mid-run crash) against a fault-free baseline with the SAME config and
+seeds, then asserts the acceptance criteria via the same ``check_soak``
+the operator script (scripts/chaos_soak.py) uses.  A separate small pair
+of runs pins the zero-cost contract: an installed-but-empty fault plan
+leaves round records byte-identical to no fault layer at all."""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+from colearn_federated_learning_tpu import faults
+from colearn_federated_learning_tpu.faults import soak as soak_lib
+
+ROUNDS = 10
+
+
+def _load_script():
+    path = (pathlib.Path(__file__).resolve().parent.parent
+            / "scripts" / "chaos_soak.py")
+    spec = importlib.util.spec_from_file_location("chaos_soak_script", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def soak_pair():
+    base = faults.run_soak(rounds=ROUNDS)
+    faulted = faults.run_soak(rounds=ROUNDS, plan=faults.canned_plan())
+    return base, faulted
+
+
+def test_canned_plan_meets_acceptance(soak_pair):
+    base, faulted = soak_pair
+    problems = _load_script().check_soak(base, faulted, ROUNDS, tol=0.1)
+    assert problems == []
+
+
+def test_no_round_records_lost(soak_pair):
+    base, faulted = soak_pair
+    for s in (base, faulted):
+        assert [r["round"] for r in s["records"]] == list(range(ROUNDS))
+
+
+def test_faulted_run_recovers_and_counts(soak_pair):
+    _, faulted = soak_pair
+    # Each scheduled spec fired its full budget — determinism, not luck.
+    plan = faults.canned_plan()
+    assert set(faulted["faults_fired"]) == set(range(len(plan.faults)))
+    assert faulted["counters"]["fault.injected_total"] == sum(
+        faulted["faults_fired"].values()
+    )
+    assert faulted["counters"]["comm.retry_total"] > 0
+    assert faulted["counters"]["comm.corrupt_frames_total"] == 1
+    assert faulted["counters"]["fed.rounds_skipped_quorum"] == 1
+    # The quorum no-op round released no aggregate...
+    skipped = [r for r in faulted["records"] if r.get("skipped_quorum")]
+    assert [r["round"] for r in skipped] == [2]
+    # ...and every non-skipped post-warmup round completed with a quorum.
+    for r in faulted["records"]:
+        if not r.get("skipped_quorum"):
+            assert r["completed"] >= max(1, r["cohort"] // 2)
+    # The crashed worker was evicted, the flaky ones were not.
+    assert faulted["evicted"] == ["3"]
+
+
+def test_fault_layer_is_zero_cost_when_disabled():
+    """Installed-but-empty plan vs no plan at all: byte-identical round
+    records (minus wall-clock fields), zero injections."""
+    kw = dict(rounds=3, n_workers=2, round_timeout=60.0)
+    plain = faults.run_soak(**kw)
+    empty = faults.run_soak(plan=faults.FaultPlan([]), **kw)
+    assert empty["counters"]["fault.injected_total"] == 0
+    a = json.dumps([soak_lib.strip_timing(r) for r in plain["records"]],
+                   sort_keys=True)
+    b = json.dumps([soak_lib.strip_timing(r) for r in empty["records"]],
+                   sort_keys=True)
+    assert a == b

@@ -481,7 +481,13 @@ def test_dh_peer_restart_refreshes_pubkey():
                 time.sleep(0.1)
 
     def run(secure):
+        import dataclasses
+
+        # Retries OFF: the transport's default retry would heal the
+        # restart within the round (reconnect + resend), hiding exactly
+        # the drop-then-refresh sequence this test pins down.
         cfg = _config(num_clients=2, secure_agg=secure)
+        cfg = cfg.replace(run=dataclasses.replace(cfg.run, comm_retries=0))
         with MessageBroker() as broker:
             w0 = DeviceWorker(cfg, 0, broker.host, broker.port).start()
             w1 = DeviceWorker(cfg, 1, broker.host, broker.port).start()
@@ -603,6 +609,111 @@ def test_socket_per_client_evaluation():
             assert len(rep["per_client"]) == 4
             assert 0.0 <= rep["acc_p10"] <= rep["acc_p50"] <= rep["acc_p90"] <= 1.0
             assert rep["weighted_acc"] > 0.5       # trained model
+        finally:
+            for w in workers:
+                w.stop()
+
+
+# ------------------------------------------------------- eviction path ----
+def _bare_coordinator(broker, cfg):
+    """Coordinator with fabricated membership — unit-tests the failure
+    bookkeeping without spinning up workers."""
+    from colearn_federated_learning_tpu.comm.enrollment import DeviceInfo
+
+    class _FakeClient:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                 want_evaluator=False)
+    devs = [DeviceInfo(device_id=str(i), host="127.0.0.1", port=1)
+            for i in range(3)]
+    coord.trainers = list(devs)
+    coord._clients = {d.device_id: _FakeClient() for d in devs}
+    return coord, devs
+
+
+def test_eviction_counts_accumulate_and_reset_on_success():
+    import dataclasses
+
+    cfg = _config(num_clients=3)
+    cfg = cfg.replace(run=dataclasses.replace(cfg.run, evict_after=3))
+    with MessageBroker() as broker:
+        coord, devs = _bare_coordinator(broker, cfg)
+        assert coord.evict_after == 3              # from RunConfig
+        assert coord._note_round_outcome(devs, ["0", "2"]) == []
+        assert coord._fail_counts == {"0": 1, "2": 1}
+        assert coord._note_round_outcome(devs, ["0"]) == []
+        # Device 2 succeeded: its streak resets; device 0 keeps counting.
+        assert coord._fail_counts == {"0": 2}
+        assert coord._note_round_outcome(devs, []) == []
+        assert coord._fail_counts == {}
+        coord.close()
+
+
+def test_eviction_after_evict_after_consecutive_failures():
+    import dataclasses
+
+    cfg = _config(num_clients=3)
+    cfg = cfg.replace(run=dataclasses.replace(cfg.run, evict_after=2))
+    with MessageBroker() as broker:
+        coord, devs = _bare_coordinator(broker, cfg)
+        cli0 = coord._clients["0"]
+        assert coord._note_round_outcome(devs, ["0"]) == []
+        assert coord._note_round_outcome(devs, ["0"]) == ["0"]
+        # Evicted: out of the trainer list, connection closed, counter
+        # cleared so a re-enrolled device starts a fresh streak.
+        assert [t.device_id for t in coord.trainers] == ["1", "2"]
+        assert "0" not in coord._clients and cli0.closed
+        assert coord._fail_counts == {}
+        coord.close()
+
+
+def test_evict_after_must_be_positive():
+    import dataclasses
+
+    cfg = _config(num_clients=3)
+    cfg = cfg.replace(run=dataclasses.replace(cfg.run, evict_after=0))
+    with MessageBroker() as broker:
+        with pytest.raises(ValueError, match="evict_after"):
+            FederatedCoordinator(cfg, broker.host, broker.port)
+
+
+def test_quorum_round_is_noop():
+    # All workers stopped mid-run: with min_cohort_fraction the round is
+    # an explicit no-op (skipped_quorum, params unchanged), not a
+    # zero-survivor aggregate.
+    import dataclasses
+
+    import jax
+
+    cfg = _config(num_clients=2, min_cohort_fraction=0.5)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(2)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=2, timeout=20.0)
+            rec = coord.run_round()
+            assert rec["completed"] == 2 and not rec.get("skipped_quorum")
+
+            for w in workers:
+                w.stop()
+            coord.round_timeout = 1.5
+            before = jax.tree.map(np.asarray, coord.server_state.params)
+            rec = coord.run_round()
+            assert rec["skipped_quorum"] and rec["completed"] == 0
+            assert np.isnan(rec["train_loss"])
+            after = jax.tree.map(np.asarray, coord.server_state.params)
+            for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+                np.testing.assert_array_equal(a, b)
+            coord.close()
         finally:
             for w in workers:
                 w.stop()

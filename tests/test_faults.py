@@ -1,0 +1,276 @@
+"""faults/: deterministic fault plans, the transport interposer seams,
+and the comm hardening they exercise (CRC framing, bounded retry,
+robustness config validation, enrollment timeout)."""
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.comm import protocol
+from colearn_federated_learning_tpu.comm.broker import (
+    BrokerClient,
+    MessageBroker,
+)
+from colearn_federated_learning_tpu.comm.enrollment import (
+    EnrollmentTimeout,
+    await_role,
+)
+from colearn_federated_learning_tpu.comm.transport import (
+    RetryPolicy,
+    TensorClient,
+    TensorServer,
+)
+from colearn_federated_learning_tpu.faults import (
+    FaultPlan,
+    FaultSpec,
+    inject,
+)
+from colearn_federated_learning_tpu.utils.config import (
+    RunConfig,
+    validate_robustness,
+)
+
+
+def _counter(name):
+    return telemetry.get_registry().counter(name).value
+
+
+@pytest.fixture
+def clean_interposer():
+    yield
+    inject.uninstall()
+
+
+# ------------------------------------------------------------------ plan ----
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec(kind="explode")
+    with pytest.raises(ValueError, match="probability"):
+        FaultSpec(kind="delay", probability=1.5)
+    with pytest.raises(ValueError, match="site"):
+        FaultSpec(kind="delay", site="middlebox")
+    with pytest.raises(ValueError):
+        FaultSpec(kind="delay", ms=-1)
+
+
+def test_plan_json_roundtrip_and_budget():
+    plan = FaultPlan([
+        FaultSpec(kind="flap_reconnect", device_id="1", round=2, op="train",
+                  count=2),
+        FaultSpec(kind="delay", ms=50.0, count=0),       # unlimited
+    ], seed=9)
+    plan2 = FaultPlan.from_json(plan.to_json())
+    assert plan2.seed == 9
+    assert plan2.faults == plan.faults
+
+    # Budget: the flap fires exactly twice, then its count is spent.
+    assert len(plan2.match("1", 2, "train", kinds=("flap_reconnect",))) == 1
+    assert len(plan2.match("1", 2, "train", kinds=("flap_reconnect",))) == 1
+    assert plan2.match("1", 2, "train", kinds=("flap_reconnect",)) == []
+    # Wildcards + count=0 never exhaust.
+    for _ in range(5):
+        assert len(plan2.match("7", 0, "eval", kinds=("delay",))) == 1
+    assert plan2.fired == {0: 2, 1: 5}
+    # Key mismatches never fire.
+    assert plan2.match("2", 2, "train", kinds=("flap_reconnect",)) == []
+    assert plan2.match("1", 3, "train", kinds=("flap_reconnect",)) == []
+
+
+def test_plan_probability_is_deterministic():
+    spec = [FaultSpec(kind="delay", probability=0.4, count=0)]
+    fires = [
+        tuple(bool(FaultPlan(spec, seed=s).match(str(d), r, "train",
+                                                 kinds=("delay",)))
+              for d in range(4) for r in range(8))
+        for s in (3, 3, 4)
+    ]
+    assert fires[0] == fires[1]          # same seed → same schedule
+    assert fires[0] != fires[2]          # different seed → different one
+    assert any(fires[0]) and not all(fires[0])   # the gate actually gates
+
+
+# ------------------------------------------------------------- protocol ----
+def test_corrupt_frame_raises_and_counts():
+    a, b = socket.socketpair()
+    try:
+        before = _counter("comm.corrupt_frames_total")
+        inject.send_corrupt_frame(a)
+        with pytest.raises(protocol.CorruptFrame):
+            protocol.recv_msg(b)
+        assert _counter("comm.corrupt_frames_total") == before + 1
+        # CorruptFrame is a ValueError: per-connection handlers that
+        # classify peer failures via ValueError keep working.
+        assert issubclass(protocol.CorruptFrame, ValueError)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_insane_header_length_is_corrupt_frame():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", 1 << 31))
+        with pytest.raises(protocol.CorruptFrame):
+            protocol.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wake_accept_honors_timeout_and_counts():
+    # Grab a port with no listener: wake_accept must fail FAST (bounded by
+    # the caller's timeout) and count the suppressed failure.
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    host, port = probe.getsockname()
+    probe.close()
+    before = _counter("comm.suppressed_oserrors_total")
+    protocol.wake_accept(host, port, timeout=0.2)      # must not raise
+    assert _counter("comm.suppressed_oserrors_total") == before + 1
+
+
+# ------------------------------------------------------------ transport ----
+def _echo(header, tree):
+    return {"meta": {"ok": True}}, tree
+
+
+def test_flap_is_retried_transparently(clean_interposer):
+    plan = FaultPlan([FaultSpec(kind="flap_reconnect", device_id="srv",
+                                op="echo", count=1)])
+    inject.install(plan)
+    before = _counter("comm.retry_total")
+    with TensorServer(_echo, ident="srv") as srv:
+        cli = TensorClient(srv.host, srv.port, ident="srv")
+        tree = {"w": np.arange(4.0)}
+        header, out = cli.request({"op": "echo"}, tree, timeout=5.0,
+                                  retry=RetryPolicy(max_retries=2,
+                                                    backoff_base=0.01))
+        assert header["status"] == "ok"
+        np.testing.assert_array_equal(out["w"], tree["w"])
+        cli.close()
+    assert plan.total_fired() == 1
+    assert _counter("comm.retry_total") > before
+
+
+def test_flap_without_retry_policy_raises(clean_interposer):
+    plan = FaultPlan([FaultSpec(kind="flap_reconnect", device_id="srv",
+                                op="echo", count=1)])
+    inject.install(plan)
+    with TensorServer(_echo, ident="srv") as srv:
+        cli = TensorClient(srv.host, srv.port, ident="srv")
+        with pytest.raises((protocol.ConnectionClosed, OSError)):
+            cli.request({"op": "echo"}, {"w": np.ones(2)}, timeout=5.0)
+        cli.close()
+
+
+def test_drop_request_times_out_then_recovers(clean_interposer):
+    plan = FaultPlan([FaultSpec(kind="drop_request", device_id="srv",
+                                op="echo", count=1)])
+    inject.install(plan)
+    with TensorServer(_echo, ident="srv") as srv:
+        cli = TensorClient(srv.host, srv.port, ident="srv")
+        # The dropped request is a genuine lost message: no reply ever
+        # comes, the client times out (retry must NOT mask a timeout).
+        with pytest.raises(TimeoutError):
+            cli.request({"op": "echo"}, {"w": np.ones(2)}, timeout=0.5,
+                        retry=RetryPolicy(max_retries=2))
+        # Budget spent: the connection is still in sync and serves again.
+        header, _ = cli.request({"op": "echo"}, {"w": np.ones(2)},
+                                timeout=5.0)
+        assert header["status"] == "ok"
+        cli.close()
+
+
+def test_corrupt_reply_is_retried(clean_interposer):
+    plan = FaultPlan([FaultSpec(kind="corrupt_payload", device_id="srv",
+                                op="echo", count=1)])
+    inject.install(plan)
+    before = _counter("comm.corrupt_frames_total")
+    with TensorServer(_echo, ident="srv") as srv:
+        cli = TensorClient(srv.host, srv.port, ident="srv")
+        header, out = cli.request({"op": "echo"}, {"w": np.ones(3)},
+                                  timeout=5.0,
+                                  retry=RetryPolicy(max_retries=2,
+                                                    backoff_base=0.01))
+        assert header["status"] == "ok"
+        cli.close()
+    assert _counter("comm.corrupt_frames_total") == before + 1
+
+
+def test_retry_deadline_is_shared(clean_interposer):
+    import time
+
+    plan = FaultPlan([FaultSpec(kind="flap_reconnect", device_id="srv",
+                                op="echo", count=0)])     # flap forever
+    inject.install(plan)
+    with TensorServer(_echo, ident="srv") as srv:
+        cli = TensorClient(srv.host, srv.port, ident="srv")
+        t0 = time.monotonic()
+        with pytest.raises((protocol.ConnectionClosed, OSError,
+                            TimeoutError)):
+            cli.request({"op": "echo"}, {"w": np.ones(2)}, timeout=10.0,
+                        retry=RetryPolicy(max_retries=50,
+                                          backoff_base=0.05),
+                        deadline=time.monotonic() + 0.8)
+        # 50 retries notwithstanding, the shared deadline bounds the call.
+        assert time.monotonic() - t0 < 5.0
+        cli.close()
+
+
+# --------------------------------------------------------------- config ----
+def test_validate_robustness_raises():
+    with pytest.raises(ValueError, match="evict_after"):
+        validate_robustness(_cfg(run=dict(evict_after=0)))
+    with pytest.raises(ValueError, match="min_cohort_fraction"):
+        validate_robustness(_cfg(fed=dict(min_cohort_fraction=1.5)))
+    with pytest.raises(ValueError, match="comm_retries"):
+        validate_robustness(_cfg(run=dict(comm_retries=-1)))
+    with pytest.raises(ValueError, match="worker_enroll_timeout"):
+        validate_robustness(_cfg(run=dict(worker_enroll_timeout=0)))
+    validate_robustness(_cfg())          # defaults pass
+
+
+def _cfg(fed=None, run=None):
+    import dataclasses
+
+    from colearn_federated_learning_tpu.utils.config import get_config
+
+    cfg = get_config("mnist_mlp_fedavg")
+    return cfg.replace(
+        fed=dataclasses.replace(cfg.fed, **(fed or {})),
+        run=dataclasses.replace(cfg.run, **(run or {})),
+    )
+
+
+def test_run_config_has_robustness_fields():
+    run = RunConfig(name="x")
+    assert run.evict_after == 3
+    assert run.worker_enroll_timeout == 3600.0
+    assert run.comm_retries == 2
+    assert run.fault_plan is None
+
+
+# ----------------------------------------------------------- enrollment ----
+def test_await_role_raises_enrollment_timeout():
+    with MessageBroker() as broker:
+        cli = BrokerClient(broker.host, broker.port)
+        cli.subscribe("colearn/role/42")
+        with pytest.raises(EnrollmentTimeout, match="no role assignment"):
+            await_role(cli, "42", timeout=0.3)
+        assert issubclass(EnrollmentTimeout, TimeoutError)
+        cli.close()
+
+
+def test_broker_client_alive_flips_on_broker_death():
+    broker = MessageBroker().start()
+    cli = BrokerClient(broker.host, broker.port)
+    assert cli.alive()
+    broker.stop()
+    deadline = __import__("time").monotonic() + 5.0
+    while cli.alive() and __import__("time").monotonic() < deadline:
+        __import__("time").sleep(0.05)
+    assert not cli.alive()
+    cli.close()
