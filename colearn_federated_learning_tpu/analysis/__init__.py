@@ -1,0 +1,10 @@
+"""Static analysis for CoLearn: `colearn lint` (see engine.py, rules.py).
+
+Kept lazy on purpose: importing the package must not import the rule set
+(or anything heavyweight) so telemetry/registry.py can depend on
+``analysis.metric_catalog`` without dragging the linter into the runtime
+import graph.
+"""
+
+__all__ = ["engine", "findings", "jit_regions", "metric_catalog",
+           "reporters", "rules"]

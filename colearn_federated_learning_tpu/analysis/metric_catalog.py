@@ -1,0 +1,101 @@
+"""Canonical catalog of every metric name the codebase may register.
+
+One declared list, imported by BOTH the runtime registry
+(telemetry/registry.py — optional strict mode, label-name validation)
+and the CL005 lint rule (analysis/rules.py), so a counter-name typo
+(``comm.retry_totl``) is a lint error at review time instead of a
+silently-empty series the chaos-soak gate never sees.
+
+Keep this module dependency-free: it is imported by telemetry/registry,
+which every layer (including jit-adjacent code) pulls in.
+
+Entries ending in ``.*`` are prefix wildcards for families minted at
+runtime (``fault.injected.<kind>``).  Labeled instruments
+(``comm.retry_total{device=3}``) are validated on the base name — the
+label suffix is stripped by :func:`base_name`.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------- catalog --
+# Counters -----------------------------------------------------------------
+COUNTERS = (
+    # checkpoint plane (ckpt/manager.py)
+    "ckpt.saves_total",
+    "ckpt.restores_total",
+    # engine plane (fed/engine.py, fed/local.py)
+    "engine.rounds_total",
+    "local.trainers_built",
+    # comm plane (comm/protocol.py, comm/transport.py, comm/worker.py)
+    "comm.messages_sent",
+    "comm.messages_received",
+    "comm.bytes_sent",
+    "comm.bytes_received",
+    "comm.corrupt_frames_total",
+    "comm.suppressed_oserrors_total",
+    "comm.retry_total",              # labeled per device: {device=<id>}
+    "comm.reenroll_total",
+    "comm.reconnect_failures_total",
+    # fault plane (faults/inject.py)
+    "fault.injected_total",
+    "fault.injected.*",              # per-kind family
+    # federation round outcomes (comm/coordinator.py)
+    "fed.rounds_total",
+    "fed.clients_dropped",
+    "fed.clients_evicted",
+    "fed.rounds_skipped_quorum",
+    # buffered-async plane (comm/async_coordinator.py)
+    "async.dispatch_failures",
+    "async.aggregations_total",
+    "async.updates_discarded_stale",
+)
+
+# Gauges -------------------------------------------------------------------
+GAUGES = (
+    "engine.h2d_transfer_s",
+    "local.steps_per_round",
+)
+
+# Histograms ---------------------------------------------------------------
+HISTOGRAMS = (
+    "ckpt.save_s",
+    "ckpt.restore_s",
+    "engine.round_time_s",
+    "fed.round_time_s",
+    "async.agg_time_s",
+)
+
+# Counters whose soak-window delta faults/soak.py reports (a curated
+# subset of COUNTERS — declared here so the soak gate and the catalog
+# cannot drift apart).
+SOAK_DELTA_COUNTERS = (
+    "comm.retry_total",
+    "comm.corrupt_frames_total",
+    "comm.reconnect_failures_total",
+    "fault.injected_total",
+    "fed.rounds_skipped_quorum",
+)
+
+METRICS: frozenset = frozenset(COUNTERS) | frozenset(GAUGES) | frozenset(
+    HISTOGRAMS
+)
+
+assert set(SOAK_DELTA_COUNTERS) <= set(COUNTERS)
+
+_WILDCARDS = tuple(sorted(m[:-1] for m in METRICS if m.endswith(".*")))
+
+
+def base_name(name: str) -> str:
+    """Strip a ``{label=value,...}`` suffix: the catalog declares base
+    names; labels are free-form attribution."""
+    brace = name.find("{")
+    return name if brace < 0 else name[:brace]
+
+
+def is_known(name: str) -> bool:
+    """True when ``name`` (label suffix ignored) is declared here, either
+    exactly or under a ``family.*`` wildcard."""
+    base = base_name(name)
+    if base in METRICS:
+        return True
+    return any(base.startswith(w) for w in _WILDCARDS)

@@ -1,0 +1,26 @@
+"""Render a LintResult for humans (terminal) or machines (JSON)."""
+
+from __future__ import annotations
+
+import json
+
+from colearn_federated_learning_tpu.analysis.engine import LintResult
+
+
+def render_text(result: LintResult) -> str:
+    lines = [f.render() for f in result.findings]
+    counts = ", ".join(
+        f"{rule}={n}" for rule, n in
+        sorted(result.to_dict()["counts"].items()))
+    tail = (f"{len(result.findings)} finding(s)"
+            + (f" [{counts}]" if counts else "")
+            + f" in {result.files} file(s)"
+            + f"; {result.suppressed} suppressed"
+            + f", {result.baselined} baselined")
+    if not result.findings:
+        return f"colearn lint: clean — {tail}"
+    return "\n".join(lines) + f"\n\ncolearn lint: {tail}"
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result.to_dict(), indent=2, sort_keys=True)

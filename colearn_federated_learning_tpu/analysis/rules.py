@@ -1,0 +1,330 @@
+"""The colearn rule set (CL001–CL006).
+
+Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
+``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
+``check(ctx)``, and decorate with ``@register``.  Rules are pure AST
+heuristics — single-file, name-based, no imports of the linted code —
+so false positives are possible and are handled with a justified
+``# colearn: noqa(RULE)`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from colearn_federated_learning_tpu.analysis import metric_catalog
+from colearn_federated_learning_tpu.analysis.engine import (
+    FileContext,
+    Rule,
+    register,
+)
+from colearn_federated_learning_tpu.analysis.findings import Finding
+from colearn_federated_learning_tpu.analysis.jit_regions import (
+    dotted_name,
+    traced_regions,
+    walk_region,
+)
+
+
+def _enclosing_functions(tree: ast.AST) -> dict:
+    """``{id(node): (outer, ..., innermost FunctionDef)}`` for every node."""
+    out: dict = {}
+
+    def visit(node: ast.AST, stack: tuple) -> None:
+        out[id(node)] = stack
+        child_stack = stack
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            child_stack = stack + (node,)
+        for child in ast.iter_child_nodes(node):
+            visit(child, child_stack)
+
+    visit(tree, ())
+    return out
+
+
+def _has_timeout_param(fn: ast.AST) -> bool:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return any("timeout" in n or "deadline" in n for n in names)
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+# ----------------------------------------------------------------- CL001 --
+@register
+class JitPurity(Rule):
+    """Side effects inside a traced function run once at trace time and
+    then never again — prints vanish, timers freeze, counters under-count."""
+
+    id = "CL001"
+    title = "side effect inside a jit/pmap/shard_map-traced function"
+    hint = ("hoist the side effect out of the traced function (use "
+            "jax.debug.print/callback if it must stay)")
+
+    _LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                    "critical", "log"}
+
+    def _effect(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            return "print()"
+        dotted = dotted_name(func)
+        for prefix in ("time.", "random.", "np.random.", "numpy.random.",
+                       "logging."):
+            if dotted.startswith(prefix):
+                return f"{dotted}()"
+        if dotted.endswith(".get_registry") or dotted == "get_registry":
+            return "metrics registry access"
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("inc", "observe"):
+                return f"metrics counter mutation .{func.attr}()"
+            base = dotted_name(func.value).lower()
+            if func.attr in self._LOG_METHODS and "log" in base:
+                return f"{dotted}()"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for region in traced_regions(ctx.tree):
+            for node in walk_region(region):
+                if not isinstance(node, ast.Call):
+                    continue
+                effect = self._effect(node)
+                if effect:
+                    yield self.finding(
+                        ctx, node,
+                        f"{effect} inside a traced function: runs once at "
+                        "trace time, never per step")
+
+
+# ----------------------------------------------------------------- CL002 --
+@register
+class SocketTimeout(Rule):
+    """Every blocking socket op in comm/ must carry an explicit timeout
+    (or live in a function that accepts one), so a dead peer costs a
+    bounded slice of the round deadline, never the whole round."""
+
+    id = "CL002"
+    title = "blocking socket operation without an explicit timeout"
+    hint = ("pass timeout= (or add a timeout/deadline parameter to the "
+            "enclosing function and settimeout before the call)")
+
+    _CLIENT_CTORS = {"BrokerClient", "TensorClient"}
+    _BLOCKING_ATTRS = {"accept", "recv"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("comm"):
+            return
+        enclosing = _enclosing_functions(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            tail = dotted.rsplit(".", 1)[-1]
+            if _has_kwarg(node, "timeout"):
+                continue
+            if (dotted.endswith("create_connection")
+                    or tail == "connect"
+                    or tail in self._CLIENT_CTORS):
+                if tail == "connect" and len(node.args) >= 3:
+                    continue      # connect(host, port, timeout) positional
+            elif (tail in self._BLOCKING_ATTRS
+                    and isinstance(node.func, ast.Attribute)):
+                # raw socket .accept()/.recv(n) have no timeout arg: require
+                # a timeout-bearing enclosing function (which is expected
+                # to settimeout the socket) or a justified noqa.
+                pass
+            else:
+                continue
+            fns = enclosing.get(id(node), ())
+            if any(_has_timeout_param(fn) for fn in fns):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{dotted or tail}() without an explicit timeout: a dead "
+                "peer blocks forever")
+
+
+# ----------------------------------------------------------------- CL003 --
+@register
+class SwallowedError(Rule):
+    """Bare ``except:`` and pass-only handlers hide real failures in the
+    planes where failures are the whole point (comm, faults, engine)."""
+
+    id = "CL003"
+    title = "bare except / silently swallowed error"
+    hint = ("narrow the exception type and count or log it "
+            "(comm.protocol.close_quietly for socket teardown)")
+
+    def _applies(self, ctx: FileContext) -> bool:
+        return (ctx.in_dir("comm") or ctx.in_dir("faults")
+                or ctx.relpath.endswith("fed/engine.py"))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not self._applies(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    ctx, node,
+                    "bare `except:` also catches SystemExit/KeyboardInterrupt")
+                continue
+            if all(isinstance(s, (ast.Pass, ast.Continue))
+                   for s in node.body):
+                caught = dotted_name(node.type) or "exception"
+                yield self.finding(
+                    ctx, node,
+                    f"`except {caught}` swallows the error with no count, "
+                    "log, or re-raise")
+
+
+# ----------------------------------------------------------------- CL004 --
+@register
+class Nondeterminism(Rule):
+    """Fault injection replays byte-identically from a seed; wall-clock
+    and unseeded RNG calls break that contract."""
+
+    id = "CL004"
+    title = "nondeterministic source in a seeded code path"
+    hint = ("thread the plan's seeded rng / use time.monotonic for "
+            "durations only")
+
+    _WALL_CLOCK = {"time.time", "datetime.now", "datetime.datetime.now",
+                   "datetime.utcnow", "datetime.datetime.utcnow"}
+    _SEEDED_CTORS = {"Random", "default_rng", "RandomState"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("faults"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            tail = dotted.rsplit(".", 1)[-1]
+            if dotted in self._WALL_CLOCK:
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() is wall-clock: replay of a seeded fault "
+                    "plan diverges")
+            elif dotted.startswith(("random.", "np.random.",
+                                    "numpy.random.")):
+                if tail in self._SEEDED_CTORS and (node.args
+                                                   or node.keywords):
+                    continue          # random.Random(seed) etc. — seeded
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() draws from global/unseeded RNG state")
+
+
+# ----------------------------------------------------------------- CL005 --
+@register
+class MetricNameDrift(Rule):
+    """Every literal metric name handed to the registry must be declared
+    in analysis/metric_catalog.py — a typo'd counter is a silently-empty
+    series the chaos-soak gate never sees."""
+
+    id = "CL005"
+    title = "metric name not declared in the catalog"
+    hint = "add it to analysis/metric_catalog.py (or fix the typo)"
+
+    _REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+    def _first_name_arg(self, call: ast.Call):
+        if call.args:
+            return call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "name":
+                return kw.value
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "analysis" in ctx.parts:
+            return  # the catalog itself and its tooling
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._REGISTRY_METHODS):
+                continue
+            arg = self._first_name_arg(node)
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if not metric_catalog.is_known(arg.value):
+                    yield self.finding(
+                        ctx, node,
+                        f"metric name {arg.value!r} is not in the catalog")
+            elif isinstance(arg, ast.JoinedStr):
+                # f"fault.injected.{kind}" — validate the static prefix
+                # against the catalog's `family.*` wildcards.
+                prefix = ""
+                for part in arg.values:
+                    if isinstance(part, ast.Constant):
+                        prefix += str(part.value)
+                    else:
+                        break
+                if not metric_catalog.is_known(prefix + "x"):
+                    yield self.finding(
+                        ctx, node,
+                        f"dynamic metric name with prefix {prefix!r} matches "
+                        "no `family.*` wildcard in the catalog")
+
+
+# ----------------------------------------------------------------- CL006 --
+@register
+class HostSyncInHotLoop(Rule):
+    """``float(x)`` / ``np.asarray`` / ``.block_until_ready()`` force a
+    device→host sync; inside traced code they trace-error or silently
+    constant-fold, and inside a marked hot loop they serialize the
+    pipeline (see PERF.md)."""
+
+    id = "CL006"
+    title = "host synchronization inside a traced region or hot loop"
+    hint = ("batch the transfer after the loop / keep values on device; "
+            "mark intentional syncs with `# colearn: noqa(CL006)`")
+
+    _SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+                   "jax.device_get"}
+
+    def _sync(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "float":
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                return "float()"
+            return None
+        dotted = dotted_name(func)
+        if dotted in self._SYNC_CALLS:
+            return f"{dotted}()"
+        if isinstance(func, ast.Attribute) and func.attr in (
+                "block_until_ready", "item"):
+            return f".{func.attr}()"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for region in traced_regions(ctx.tree):
+            for node in walk_region(region):
+                what = self._sync(node)
+                if what:
+                    yield self.finding(
+                        ctx, node,
+                        f"{what} inside a traced function forces a host "
+                        "sync (or fails to trace)")
+        hot = ctx.hot_lines()
+        if not hot:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.While)) and node.lineno in hot:
+                for inner in ast.walk(node):
+                    what = self._sync(inner)
+                    if what:
+                        yield self.finding(
+                            ctx, inner,
+                            f"{what} inside a `# colearn: hot` loop "
+                            "serializes the device pipeline")

@@ -499,6 +499,51 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     return 0 if ok else 1
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """Run the AST lint (analysis/) — CPU-only, never initializes jax."""
+    import os
+
+    from colearn_federated_learning_tpu.analysis import engine as lint_engine
+    from colearn_federated_learning_tpu.analysis import reporters
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    if args.root:
+        root = os.path.abspath(args.root)
+    else:
+        root = next(
+            (c for c in (os.getcwd(), os.path.dirname(pkg_dir))
+             if os.path.exists(os.path.join(c, "pyproject.toml"))),
+            os.getcwd())
+    config = lint_engine.LintConfig.from_pyproject(root)
+    if args.rules:
+        config.enable = [r.strip() for r in args.rules.split(",")]
+    if args.disable:
+        config.disable = tuple(
+            r.strip() for r in args.disable.split(","))
+    try:
+        eng = lint_engine.LintEngine(config=config, root=root)
+    except ValueError as e:
+        print(f"colearn lint: {e}", file=sys.stderr)
+        return 2
+    paths = args.paths or [pkg_dir]
+    baseline_path = (os.path.join(root, args.baseline)
+                     if args.baseline else None)
+    if args.write_baseline:
+        # Lint without the current baseline, then accept everything found.
+        result = eng.run(paths, baseline_path="")
+        target = baseline_path or os.path.join(root, config.baseline)
+        entries = lint_engine.write_baseline(target, result.findings)
+        print(f"colearn lint: baselined {len(result.findings)} finding(s) "
+              f"({len(entries)} fingerprint(s)) -> {target}")
+        return 0
+    result = eng.run(paths, baseline_path=baseline_path)
+    if args.format == "json":
+        print(reporters.render_json(result))
+    else:
+        print(reporters.render_text(result))
+    return result.exit_code
+
+
 def cmd_trace_summary(args: argparse.Namespace) -> int:
     from colearn_federated_learning_tpu import telemetry
 
@@ -653,6 +698,30 @@ def main(argv: list[str] | None = None) -> int:
     p_chaos.add_argument("--no-faults", action="store_true",
                          help="run the soak without any plan (baseline)")
     p_chaos.set_defaults(fn=cmd_chaos)
+
+    p_lint = sub.add_parser("lint",
+                            help="run the AST invariant checks "
+                                 "(CL001-CL006; analysis/) — fast, "
+                                 "CPU-only, no jax init")
+    p_lint.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the installed "
+                             "package)")
+    p_lint.add_argument("--format", choices=["text", "json"], default="text")
+    p_lint.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all registered)")
+    p_lint.add_argument("--disable", default=None,
+                        help="comma-separated rule ids to skip")
+    p_lint.add_argument("--baseline", default=None,
+                        help="baseline JSON path relative to --root "
+                             "(default: [tool.colearn.lint].baseline)")
+    p_lint.add_argument("--write-baseline", action="store_true",
+                        help="accept every current finding into the "
+                             "baseline file and exit 0")
+    p_lint.add_argument("--root", default=None,
+                        help="repo root holding pyproject.toml + baseline "
+                             "(default: cwd, else the package parent)")
+    p_lint.set_defaults(fn=cmd_lint)
 
     p_trace = sub.add_parser("trace-summary",
                              help="print a per-phase time breakdown of a "

@@ -103,7 +103,8 @@ class AsyncFederatedCoordinator:
         self.max_staleness = max_staleness
         self.request_timeout = request_timeout
         self.want_evaluator = want_evaluator
-        self._broker = BrokerClient(broker_host, broker_port)
+        self._broker = BrokerClient(broker_host, broker_port,
+                                    timeout=protocol.CONNECT_TIMEOUT)
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy)
         params = setup_lib.init_global_params(config)
         self.server_state = strategies.init_server_state(params, config.fed)
@@ -139,8 +140,9 @@ class AsyncFederatedCoordinator:
             want_evaluator=self.want_evaluator
         )
         for d in self.trainers + ([self.evaluator] if self.evaluator else []):
-            self._clients[d.device_id] = TensorClient(d.host, d.port,
-                                                      ident=d.device_id)
+            self._clients[d.device_id] = TensorClient(
+                d.host, d.port, timeout=protocol.CONNECT_TIMEOUT,
+                ident=d.device_id)
 
     def close(self) -> None:
         self._stop.set()
@@ -216,6 +218,7 @@ class AsyncFederatedCoordinator:
                 try:
                     cli.close()
                     cli = TensorClient(dev.host, dev.port,
+                                       timeout=protocol.CONNECT_TIMEOUT,
                                        ident=dev.device_id)
                     self._clients[dev.device_id] = cli
                 except OSError:

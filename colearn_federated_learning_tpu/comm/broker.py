@@ -61,10 +61,7 @@ class MessageBroker:
     def stop(self, wake_timeout: float = 1.0) -> None:
         self._stopping.set()
         protocol.wake_accept(self.host, self.port, timeout=wake_timeout)
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        protocol.close_quietly(self._srv)
         with self._lock:
             # Every accepted connection (tracked by its write lock), not
             # just the subscribed ones — a stopped broker must sever
@@ -77,14 +74,7 @@ class MessageBroker:
             # serve thread blocked in recv (the in-flight syscall pins the
             # kernel socket), so no FIN would reach the peer and clients
             # could never detect the broker's death.
-            try:
-                s.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                s.close()
-            except OSError:
-                pass
+            protocol.close_quietly(s, shutdown=True)
 
     def __enter__(self):
         return self.start()
@@ -96,16 +86,15 @@ class MessageBroker:
     def _accept_loop(self) -> None:
         while not self._stopping.is_set():
             try:
-                conn, _ = self._srv.accept()
+                # Blocking by design: stop() always sends a wake_accept
+                # connection, so this never outlives the broker.
+                conn, _ = self._srv.accept()  # colearn: noqa(CL002)
             except OSError:
-                return
+                return  # listener closed by stop()
             # Re-check AFTER accept: some loopback shims deliver one more
             # connection even though the listener was closed by stop().
             if self._stopping.is_set():
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                protocol.close_quietly(conn)
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
@@ -127,16 +116,15 @@ class MessageBroker:
                     self._publish(header, body)
                 elif op == "ping":
                     self._send(conn, {"op": "pong"}, b"")
-        except (protocol.ConnectionClosed, OSError, ValueError):
-            pass
+        except protocol.ConnectionClosed:  # colearn: noqa(CL003)
+            pass                           # normal client disconnect
+        except (OSError, ValueError):
+            protocol.count_suppressed()  # flaky/buggy peer; drop it
         finally:
             with self._lock:
                 self._subs.pop(conn, None)
                 self._wlocks.pop(conn, None)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            protocol.close_quietly(conn)
 
     def _send(self, conn: socket.socket, header: dict, body: bytes) -> None:
         with self._lock:
@@ -147,7 +135,9 @@ class MessageBroker:
             with wlock:
                 protocol.send_msg(conn, header, body)
         except OSError:
-            pass
+            # A dead subscriber must not break fan-out to the others; its
+            # serve thread reaps it on the next recv.
+            protocol.count_suppressed()
 
     def _subscribe(self, conn: socket.socket, pattern: str,
                    ack: bool = False) -> None:
@@ -248,7 +238,4 @@ class BrokerClient:
         return item
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        protocol.close_quietly(self._sock)

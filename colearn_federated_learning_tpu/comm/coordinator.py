@@ -96,7 +96,8 @@ class FederatedCoordinator:
         # metadata so one trace covers the whole federation.  The CLI
         # writes it to RunConfig.trace_dir after fit.
         self.tracer = telemetry.Tracer(process="coordinator")
-        self._broker = BrokerClient(broker_host, broker_port)
+        self._broker = BrokerClient(broker_host, broker_port,
+                                    timeout=protocol.CONNECT_TIMEOUT)
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy,
                                          device_type=device_type)
         params = setup_lib.init_global_params(config)
@@ -128,8 +129,9 @@ class FederatedCoordinator:
             want_evaluator=self.want_evaluator
         )
         for d in self.trainers + ([self.evaluator] if self.evaluator else []):
-            self._clients[d.device_id] = TensorClient(d.host, d.port,
-                                                      ident=d.device_id)
+            self._clients[d.device_id] = TensorClient(
+                d.host, d.port, timeout=protocol.CONNECT_TIMEOUT,
+                ident=d.device_id)
 
     def close(self) -> None:
         for c in self._clients.values():
@@ -187,8 +189,9 @@ class FederatedCoordinator:
         peer stays closed — survivable, but counted, never silent."""
         self._clients[dev.device_id].close()
         try:
-            self._clients[dev.device_id] = TensorClient(dev.host, dev.port,
-                                                        ident=dev.device_id)
+            self._clients[dev.device_id] = TensorClient(
+                dev.host, dev.port, timeout=protocol.CONNECT_TIMEOUT,
+                ident=dev.device_id)
         except OSError:
             telemetry.get_registry().counter(
                 "comm.reconnect_failures_total").inc()

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Optional
 
+from colearn_federated_learning_tpu.comm import protocol
 from colearn_federated_learning_tpu.comm.broker import BrokerClient
 
 ENROLL_TOPIC = "colearn/enroll/"      # + device_id (retained)
@@ -300,9 +301,13 @@ def admit_late_joiners(enroll: "EnrollmentManager", broker, trainers: list,
         if d.device_id in known:
             continue
         try:
-            clients[d.device_id] = TensorClient(d.host, d.port,
-                                                ident=d.device_id)
+            clients[d.device_id] = TensorClient(
+                d.host, d.port, timeout=protocol.CONNECT_TIMEOUT,
+                ident=d.device_id)
         except OSError:
+            # Announced but unreachable (died between enroll and admit):
+            # skip it this poll — survivable, counted, never silent.
+            protocol.count_suppressed()
             continue
         broker.publish(ROLE_TOPIC + d.device_id,
                        {"role": "trainer"}, retain=True)

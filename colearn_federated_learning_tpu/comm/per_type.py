@@ -23,6 +23,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from colearn_federated_learning_tpu.comm import protocol
 from colearn_federated_learning_tpu.comm.broker import BrokerClient
 from colearn_federated_learning_tpu.comm.coordinator import (
     FederatedCoordinator,
@@ -39,7 +40,8 @@ def discover_types(broker_host: str, broker_port: int,
     records, waiting until at least ``min_devices`` admitted devices are
     visible.  Profile-less devices group under ``""`` (callers decide
     whether an untyped federation makes sense)."""
-    client = BrokerClient(broker_host, broker_port)
+    client = BrokerClient(broker_host, broker_port,
+                          timeout=protocol.CONNECT_TIMEOUT)
     try:
         enroll = EnrollmentManager(client, mud_policy=mud_policy)
         enroll.wait_for(min_devices, timeout)

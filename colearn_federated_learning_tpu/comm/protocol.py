@@ -83,7 +83,9 @@ class CorruptFrame(ValueError):
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        # The per-read deadline is the caller's settimeout (BrokerClient
+        # drains via a reader thread; TensorServer sets a serve timeout).
+        chunk = sock.recv(min(n - len(buf), 1 << 20))  # colearn: noqa(CL002)
         if not chunk:
             raise ConnectionClosed(f"peer closed after {len(buf)}/{n} bytes")
         buf.extend(chunk)
@@ -138,10 +140,37 @@ def recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
     return header, body
 
 
+# Default budget for control-plane connection establishment: generous
+# against slow brokers, finite against dead ones (CL002 contract).
+CONNECT_TIMEOUT = 10.0
+
+
 def connect(host: str, port: int, timeout: Optional[float] = None) -> socket.socket:
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return sock
+
+
+def count_suppressed(n: int = 1) -> None:
+    """Record an intentionally-suppressed teardown error — survivable but
+    never silent (CL003 contract)."""
+    _metrics.get_registry().counter("comm.suppressed_oserrors_total").inc(n)
+
+
+def close_quietly(sock: socket.socket, shutdown: bool = False) -> None:
+    """Teardown close: OSErrors are expected here (the peer may already be
+    gone) and are counted in ``comm.suppressed_oserrors_total`` instead of
+    swallowed.  ``shutdown=True`` also shuts the stream down first — see
+    MessageBroker.stop for why close() alone cannot unblock a reader."""
+    if shutdown:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            count_suppressed()
+    try:
+        sock.close()
+    except OSError:
+        count_suppressed()
 
 
 def wake_accept(host: str, port: int, timeout: float = 1.0) -> None:

@@ -131,28 +131,16 @@ class TensorServer:
         already-open sockets.  Close errors are survivable (the peer may
         have dropped first) but never silent: each is counted in
         ``comm.suppressed_oserrors_total``."""
-        suppressed = _metrics.get_registry().counter(
-            "comm.suppressed_oserrors_total")
         self._stopping.set()
         # A worker restarting on its own port must be able to rebind:
         # wake the blocked accept before closing (protocol.wake_accept).
         protocol.wake_accept(self.host, self.port, timeout=wake_timeout)
-        try:
-            self._srv.close()
-        except OSError:
-            suppressed.inc()
+        protocol.close_quietly(self._srv)
         with self._conns_lock:
             conns = list(self._conns)
             self._conns.clear()
         for c in conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                suppressed.inc()
-            try:
-                c.close()
-            except OSError:
-                suppressed.inc()
+            protocol.close_quietly(c, shutdown=True)
 
     def __enter__(self):
         return self.start()
@@ -163,16 +151,15 @@ class TensorServer:
     def _accept_loop(self) -> None:
         while not self._stopping.is_set():
             try:
-                conn, _ = self._srv.accept()
+                # Blocking by design: stop() always sends a wake_accept
+                # connection, so this never outlives the server.
+                conn, _ = self._srv.accept()  # colearn: noqa(CL002)
             except OSError:
-                return
+                return  # listener closed by stop()
             # Re-check AFTER accept: some loopback shims deliver one more
             # connection even though the listener was closed by stop().
             if self._stopping.is_set():
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                protocol.close_quietly(conn)
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conns_lock:
@@ -188,8 +175,9 @@ class TensorServer:
                 try:
                     if ip is not None:
                         ip.server_request(self, conn, header)
-                except SkipRequest:
-                    continue              # request "lost": no reply at all
+                except SkipRequest:       # colearn: noqa(CL003)
+                    continue              # request "lost" BY DESIGN: the
+                    # interposer asked for a drop; no reply at all
                 tree, meta = bytes_to_pytree(body) if body else (None, {})
                 header.setdefault("meta", meta)
                 try:
@@ -205,15 +193,14 @@ class TensorServer:
                 if ip is not None:
                     ip.server_reply(self, conn, header)
                 protocol.send_msg(conn, out_header, out_body)
-        except (protocol.ConnectionClosed, OSError, ValueError):
-            pass
+        except protocol.ConnectionClosed:  # colearn: noqa(CL003)
+            pass                           # normal peer disconnect
+        except (OSError, ValueError):
+            protocol.count_suppressed()  # flaky/buggy peer; drop it
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            protocol.close_quietly(conn)
 
 
 # Failure classes a retry can actually fix: the peer is (or may be) alive
@@ -237,11 +224,7 @@ class TensorClient:
         self._sock = protocol.connect(host, port, timeout=timeout)
 
     def _reconnect(self, timeout: Optional[float]) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            _metrics.get_registry().counter(
-                "comm.suppressed_oserrors_total").inc()
+        protocol.close_quietly(self._sock)
         self._sock = protocol.connect(self._host, self._port, timeout=timeout)
 
     def request(self, header: dict, tree: Any = None,
@@ -259,7 +242,10 @@ class TensorClient:
         sleep, so retrying never extends the caller's one budget."""
         body = pytree_to_bytes(tree, meta) if tree is not None else b""
         attempts = 1 + (retry.max_retries if retry is not None else 0)
-        retries = _metrics.get_registry().counter("comm.retry_total")
+        # Labeled per peer: the aggregate still counts every retry, and
+        # the {device=...} children answer "who is flaky?" in snapshots.
+        retries = _metrics.get_registry().counter(
+            "comm.retry_total", labels={"device": self.ident})
         for attempt in range(attempts):
             attempt_timeout = timeout
             if deadline is not None:
@@ -304,7 +290,4 @@ class TensorClient:
         return out_header, out_tree
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        protocol.close_quietly(self._sock)

@@ -160,7 +160,8 @@ class DeviceWorker:
         self._server.start()
         bh, bp = self._broker_addr
         if bh is not None:
-            self._broker = BrokerClient(bh, bp)
+            self._broker = BrokerClient(bh, bp,
+                                        timeout=protocol.CONNECT_TIMEOUT)
             self._announce(self._broker)
             self._watchdog = threading.Thread(
                 target=self._watch_broker,
@@ -203,7 +204,8 @@ class DeviceWorker:
                 backoff = poll
                 continue
             try:
-                fresh = BrokerClient(bh, bp)
+                fresh = BrokerClient(bh, bp,
+                                     timeout=protocol.CONNECT_TIMEOUT)
             except OSError:
                 # Broker still down: back off (capped) and keep trying.
                 if self._watch_stop.wait(backoff):
@@ -330,7 +332,8 @@ class DeviceWorker:
                 raise RuntimeError("worker is stopped")
             if self._dh_lookup is None:
                 bh, bp = self._broker_addr
-                self._dh_lookup = BrokerClient(bh, bp)
+                self._dh_lookup = BrokerClient(
+                    bh, bp, timeout=protocol.CONNECT_TIMEOUT)
             if self._peer_round != round_idx:
                 self._peer_info_cache.clear()
                 self._peer_round = round_idx

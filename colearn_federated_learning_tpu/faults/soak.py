@@ -25,13 +25,10 @@ from colearn_federated_learning_tpu.utils.config import (
     RunConfig,
 )
 
-# Counters whose soak-window delta the summary reports.
-_COUNTERS = (
-    "comm.retry_total",
-    "comm.corrupt_frames_total",
-    "comm.reconnect_failures_total",
-    "fault.injected_total",
-    "fed.rounds_skipped_quorum",
+# Counters whose soak-window delta the summary reports — declared once in
+# the metric catalog so this gate and CL005 can never drift apart.
+from colearn_federated_learning_tpu.analysis.metric_catalog import (
+    SOAK_DELTA_COUNTERS as _COUNTERS,
 )
 
 

@@ -10,10 +10,26 @@ dispatcher threads) and dependency-free.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Optional, Union
 
+from colearn_federated_learning_tpu.analysis import metric_catalog
+
 Number = Union[int, float]
+
+# Opt-in guard for ad-hoc scripts: with COLEARN_METRICS_STRICT=1, a name
+# missing from analysis/metric_catalog.py raises at first touch.  The
+# default stays permissive (tests register scratch instruments); the
+# CL005 lint enforces the catalog on the codebase itself either way.
+_STRICT = os.environ.get("COLEARN_METRICS_STRICT", "") not in ("", "0")
+
+
+def labeled_name(name: str, labels: dict) -> str:
+    """Canonical key for a labeled instrument: ``name{k=v,...}`` with
+    keys sorted, so the same label set always maps to the same child."""
+    items = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{items}}}"
 
 
 class Counter:
@@ -33,6 +49,21 @@ class Counter:
     @property
     def value(self) -> float:
         return self._value
+
+
+class _ChildCounter(Counter):
+    """Labeled child (``comm.retry_total{device=3}``): every increment
+    rolls up into the unlabeled parent, so aggregate readers (the soak
+    gate's counter deltas, coordinator round records) keep working while
+    snapshots additionally show per-label attribution."""
+
+    def __init__(self, name: str, parent: Counter):
+        super().__init__(name)
+        self._parent = parent
+
+    def inc(self, n: Number = 1) -> None:
+        super().inc(n)
+        self._parent.inc(n)
 
 
 class Gauge:
@@ -113,6 +144,12 @@ class MetricsRegistry:
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:
+                if _STRICT and not metric_catalog.is_known(name):
+                    raise ValueError(
+                        f"metric {name!r} is not declared in "
+                        "analysis/metric_catalog.py "
+                        "(COLEARN_METRICS_STRICT=1)"
+                    )
                 inst = self._instruments[name] = cls(name, **kw)
             elif not isinstance(inst, cls):
                 raise TypeError(
@@ -121,8 +158,25 @@ class MetricsRegistry:
                 )
             return inst
 
-    def counter(self, name: str) -> Counter:
-        return self._get(name, Counter)
+    def counter(self, name: str,
+                labels: Optional[dict] = None) -> Counter:
+        """Without ``labels``, the (aggregate) counter.  With ``labels``,
+        the child registered under ``name{k=v,...}`` whose increments
+        also roll up into the aggregate (see _ChildCounter)."""
+        parent = self._get(name, Counter)
+        if not labels:
+            return parent
+        full = labeled_name(name, labels)
+        with self._lock:
+            inst = self._instruments.get(full)
+            if inst is None:
+                inst = self._instruments[full] = _ChildCounter(full, parent)
+            elif not isinstance(inst, Counter):
+                raise TypeError(
+                    f"metric {full!r} is a {type(inst).__name__}, "
+                    "not a Counter"
+                )
+            return inst
 
     def gauge(self, name: str) -> Gauge:
         return self._get(name, Gauge)

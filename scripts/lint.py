@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Tier-1 lint gate: `python scripts/lint.py [paths...]`.
+
+Thin wrapper over `colearn lint` that pins the repo root to this
+checkout, so CI and pre-test hooks get the checked-in pyproject config
+and baseline regardless of cwd.  Fast and CPU-only: nothing on this
+path imports jax or touches a device.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    sys.path.insert(0, REPO_ROOT)
+    from colearn_federated_learning_tpu.cli import main as cli_main
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    return cli_main(["lint", "--root", REPO_ROOT, *args])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -153,6 +153,8 @@ def test_flap_is_retried_transparently(clean_interposer):
         cli.close()
     assert plan.total_fired() == 1
     assert _counter("comm.retry_total") > before
+    # Labeled roll-up: the retry is also attributed to the flaky peer.
+    assert _counter("comm.retry_total{device=srv}") > 0
 
 
 def test_flap_without_retry_policy_raises(clean_interposer):

@@ -1,0 +1,445 @@
+"""analysis/: one positive + one suppression fixture per rule
+(CL001–CL006), the noqa/baseline machinery (CL000 dead suppressions,
+line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
+labeled-counter roll-up the registry grew for per-device attribution,
+and the tier-1 self-check that the installed package is lint-clean."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from colearn_federated_learning_tpu.analysis.engine import (
+    LintConfig,
+    LintEngine,
+    write_baseline,
+)
+from colearn_federated_learning_tpu.cli import main as cli_main
+from colearn_federated_learning_tpu.telemetry import registry as telemetry_registry
+from colearn_federated_learning_tpu.telemetry.registry import MetricsRegistry
+
+
+def run_lint(tmp_path, source, relpath="pkg/comm/mod.py", rules=None,
+             baseline=""):
+    """Lint one fixture file placed at ``relpath`` under a scratch root
+    (the directory names drive the scoped rules: comm/, faults/)."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    eng = LintEngine(config=LintConfig(enable=rules), root=str(tmp_path))
+    return eng.run([str(path)], baseline_path=baseline)
+
+
+def rule_ids(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ------------------------------------------------------------- CL001 ----
+def test_cl001_flags_print_in_jit_decorated_function(tmp_path):
+    res = run_lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("tracing", x)
+            return x
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL001"]
+    assert res.exit_code == 1
+
+
+def test_cl001_flags_time_call_in_jit_call_site_target(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+        from jax import jit
+
+        def train(x):
+            t0 = time.perf_counter()
+            return x + t0
+
+        train_fast = jit(train)
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL001"]
+
+
+def test_cl001_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("trace marker")  # colearn: noqa(CL001)
+            return x
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl001_ignores_untraced_functions(tmp_path):
+    res = run_lint(tmp_path, """
+        def host_side(x):
+            print(x)
+            return x
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == []
+
+
+# ------------------------------------------------------------- CL002 ----
+def test_cl002_flags_untimed_client_and_recv_in_comm(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.broker import BrokerClient
+
+        def attach(host, port):
+            return BrokerClient(host, port)
+
+        def drain(sock):
+            return sock.recv(4)
+    """)
+    assert rule_ids(res) == ["CL002"]
+    assert len(res.findings) == 2
+
+
+def test_cl002_passes_timeout_kwarg_and_timeout_bearing_function(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.broker import BrokerClient
+
+        def attach(host, port):
+            return BrokerClient(host, port, timeout=5.0)
+
+        def drain(sock, timeout):
+            sock.settimeout(timeout)
+            return sock.recv(4)
+    """)
+    assert res.findings == []
+
+
+def test_cl002_only_applies_under_comm(tmp_path):
+    res = run_lint(tmp_path, """
+        def drain(sock):
+            return sock.recv(4)
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == []
+
+
+def test_cl002_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def accept_forever(srv):
+            return srv.accept()  # colearn: noqa(CL002)
+    """)
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL003 ----
+def test_cl003_flags_bare_except_and_swallowed_handler(tmp_path):
+    res = run_lint(tmp_path, """
+        def teardown(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            try:
+                sock.detach()
+            except:
+                return None
+    """)
+    assert rule_ids(res) == ["CL003"]
+    assert len(res.findings) == 2
+
+
+def test_cl003_allows_handlers_with_real_bodies(tmp_path):
+    res = run_lint(tmp_path, """
+        def teardown(sock, counter):
+            try:
+                sock.close()
+            except OSError:
+                counter.inc()
+    """)
+    assert res.findings == []
+
+
+def test_cl003_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def teardown(sock):
+            try:
+                sock.close()
+            except OSError:  # colearn: noqa(CL003)
+                pass
+    """)
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL004 ----
+def test_cl004_flags_wall_clock_and_unseeded_rng_in_faults(tmp_path):
+    res = run_lint(tmp_path, """
+        import random
+        import time
+
+        def jitter():
+            return random.random() + time.time()
+    """, relpath="pkg/faults/mod.py")
+    assert rule_ids(res) == ["CL004"]
+    assert len(res.findings) == 2
+
+
+def test_cl004_allows_seeded_rng_and_monotonic(tmp_path):
+    res = run_lint(tmp_path, """
+        import random
+        import time
+
+        def jitter(seed):
+            rng = random.Random(seed)
+            return rng.uniform(0, 1), time.monotonic()
+    """, relpath="pkg/faults/mod.py")
+    assert res.findings == []
+
+
+def test_cl004_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+
+        def stamp():
+            return time.time()  # colearn: noqa(CL004)
+    """, relpath="pkg/faults/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL005 ----
+def test_cl005_flags_typoed_counter_name(tmp_path):
+    res = run_lint(tmp_path, """
+        def bump(registry):
+            registry.counter("comm.retry_totl").inc()
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL005"]
+
+
+def test_cl005_passes_catalog_names_and_wildcard_fstrings(tmp_path):
+    res = run_lint(tmp_path, """
+        def bump(registry, kind):
+            registry.counter("comm.retry_total").inc()
+            registry.counter(f"fault.injected.{kind}").inc()
+            registry.histogram("fed.round_time_s").observe(1.0)
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == []
+
+
+def test_cl005_flags_fstring_with_unknown_prefix(tmp_path):
+    res = run_lint(tmp_path, """
+        def bump(registry, kind):
+            registry.counter(f"surprise.{kind}").inc()
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL005"]
+
+
+def test_cl005_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def bump(registry):
+            registry.counter("scratch.local_only").inc()  # colearn: noqa(CL005)
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL006 ----
+def test_cl006_flags_host_sync_in_traced_function(tmp_path):
+    res = run_lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x)
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL006"]
+
+
+def test_cl006_flags_block_until_ready_in_hot_loop(tmp_path):
+    res = run_lint(tmp_path, """
+        def fit(batches):
+            for b in batches:  # colearn: hot
+                b.result.block_until_ready()
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL006"]
+
+
+def test_cl006_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x)  # colearn: noqa(CL006)
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl006_allows_host_sync_outside_hot_paths(tmp_path):
+    res = run_lint(tmp_path, """
+        def summarize(x):
+            return float(x)
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == []
+
+
+# ------------------------------------------- engine machinery ----------
+def test_cl000_dead_suppression_is_reported(tmp_path):
+    res = run_lint(tmp_path, """
+        X = 1  # colearn: noqa(CL002)
+    """)
+    assert rule_ids(res) == ["CL000"]
+
+
+def test_blanket_noqa_suppresses_every_rule_on_the_line(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+
+        def jitter():
+            return time.time()  # colearn: noqa
+    """, relpath="pkg/faults/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_syntax_error_becomes_cl999_finding(tmp_path):
+    res = run_lint(tmp_path, "def broken(:\n")
+    assert rule_ids(res) == ["CL999"]
+    assert res.exit_code == 1
+
+
+def test_docstring_mentioning_noqa_does_not_suppress(tmp_path):
+    res = run_lint(tmp_path, '''
+        def teardown(sock):
+            """Mentions # colearn: noqa(CL003) in prose only."""
+            try:
+                sock.close()
+            except OSError:
+                pass
+    ''')
+    assert rule_ids(res) == ["CL003"]
+
+
+def test_baseline_absorbs_findings_and_survives_line_shifts(tmp_path):
+    src = """
+        def teardown(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+    """
+    res = run_lint(tmp_path, src)
+    assert len(res.findings) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), res.findings)
+
+    # Same finding, two lines lower: the fingerprint hashes source text,
+    # not line numbers, so the baseline still covers it.
+    shifted = "\n# shifted\n# shifted\n" + textwrap.dedent(src)
+    res2 = run_lint(tmp_path, shifted, baseline=str(bl))
+    assert res2.findings == [] and res2.baselined == 1
+
+
+def test_unknown_rule_id_raises():
+    with pytest.raises(ValueError, match="unknown lint rule"):
+        LintEngine(config=LintConfig(enable=["CL404"]))
+
+
+def test_config_disable_skips_rule(tmp_path):
+    res = run_lint(tmp_path, """
+        def drain(sock):
+            return sock.recv(4)
+    """, rules=None, baseline="")
+    assert rule_ids(res) == ["CL002"]
+    path = tmp_path / "pkg/comm/mod.py"
+    eng = LintEngine(config=LintConfig(disable=("CL002",)),
+                     root=str(tmp_path))
+    assert eng.run([str(path)], baseline_path="").findings == []
+
+
+# ------------------------------------------------------------- CLI ------
+def _write_fixture(tmp_path, source, relpath="pkg/comm/mod.py"):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return path
+
+
+def test_cli_lint_exits_nonzero_and_emits_json(tmp_path, capsys):
+    bad = _write_fixture(tmp_path, """
+        def drain(sock):
+            return sock.recv(4)
+    """)
+    rc = cli_main(["lint", str(bad), "--root", str(tmp_path),
+                   "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["counts"] == {"CL002": 1}
+    assert doc["findings"][0]["rule"] == "CL002"
+    assert doc["findings"][0]["line"] == 3
+
+
+def test_cli_lint_exits_zero_on_clean_tree(tmp_path, capsys):
+    clean = _write_fixture(tmp_path, "X = 1\n")
+    rc = cli_main(["lint", str(clean), "--root", str(tmp_path)])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_lint_unknown_rule_is_usage_error(tmp_path, capsys):
+    clean = _write_fixture(tmp_path, "X = 1\n")
+    rc = cli_main(["lint", str(clean), "--root", str(tmp_path),
+                   "--rules", "CL404"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    _write_fixture(tmp_path, """
+        def drain(sock):
+            return sock.recv(4)
+    """)
+    target = str(tmp_path / "pkg")
+    rc = cli_main(["lint", target, "--root", str(tmp_path),
+                   "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    assert (tmp_path / "lint_baseline.json").exists()
+    rc = cli_main(["lint", target, "--root", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+# ------------------------------------------- labeled counters ----------
+def test_counter_labels_roll_up_into_aggregate():
+    reg = MetricsRegistry()
+    reg.counter("comm.retry_total", labels={"device": "3"}).inc(2)
+    reg.counter("comm.retry_total", labels={"device": "5"}).inc()
+    snap = reg.snapshot()
+    assert snap["comm.retry_total"] == 3.0
+    assert snap["comm.retry_total{device=3}"] == 2.0
+    assert snap["comm.retry_total{device=5}"] == 1.0
+
+
+def test_counter_labels_same_set_returns_same_child():
+    reg = MetricsRegistry()
+    a = reg.counter("comm.retry_total", labels={"device": "3"})
+    b = reg.counter("comm.retry_total", labels={"device": "3"})
+    assert a is b
+    # The unlabeled aggregate is the parent, untouched until a child incs.
+    assert reg.counter("comm.retry_total").value == 0.0
+
+
+def test_strict_mode_rejects_uncataloged_names(monkeypatch):
+    monkeypatch.setattr(telemetry_registry, "_STRICT", True)
+    reg = MetricsRegistry()
+    reg.counter("comm.retry_total").inc()           # cataloged: fine
+    reg.counter("fault.injected.delay")             # wildcard family: fine
+    with pytest.raises(ValueError, match="metric_catalog"):
+        reg.counter("comm.retry_totl")
+
+
+# ------------------------------------------- tier-1 self-check ----------
+def test_installed_package_is_lint_clean():
+    import colearn_federated_learning_tpu as pkg
+
+    pkg_dir = os.path.dirname(os.path.abspath(pkg.__file__))
+    root = os.path.dirname(pkg_dir)
+    eng = LintEngine(config=LintConfig.from_pyproject(root), root=root)
+    res = eng.run([pkg_dir])
+    assert res.findings == [], "\n".join(f.render() for f in res.findings)
+    assert res.files > 50
