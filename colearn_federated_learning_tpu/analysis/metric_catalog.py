@@ -36,6 +36,10 @@ COUNTERS = (
     "comm.retry_total",              # labeled per device: {device=<id>}
     "comm.reenroll_total",
     "comm.reconnect_failures_total",
+    # wire fast path (comm/downlink.py, comm/coordinator.py)
+    "comm.broadcast_encode_total",   # CLW1 encodes of a broadcast frame
+    "comm.bytes_saved_downlink",     # delta vs full-params payload bytes
+    "comm.resync_total",             # worker cache misses → full re-send
     # fault plane (faults/inject.py)
     "fault.injected_total",
     "fault.injected.*",              # per-kind family

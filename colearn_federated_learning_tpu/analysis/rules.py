@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL006).
+"""The colearn rule set (CL001–CL007).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -116,7 +116,7 @@ class SocketTimeout(Rule):
             "enclosing function and settimeout before the call)")
 
     _CLIENT_CTORS = {"BrokerClient", "TensorClient"}
-    _BLOCKING_ATTRS = {"accept", "recv"}
+    _BLOCKING_ATTRS = {"accept", "recv", "recv_into"}
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if not ctx.in_dir("comm"):
@@ -328,3 +328,44 @@ class HostSyncInHotLoop(Rule):
                             ctx, inner,
                             f"{what} inside a `# colearn: hot` loop "
                             "serializes the device pipeline")
+
+
+# ----------------------------------------------------------------- CL007 --
+@register
+class SerializeInFanOutLoop(Rule):
+    """The coordinator's broadcast is serialize-ONCE: one CLW1 encode per
+    round, shared read-only by every cohort send (comm/downlink.py).  A
+    ``pytree_to_bytes`` (or npz save) inside a ``# colearn: hot`` fan-out
+    loop re-encodes the full model per device per round — exactly the
+    O(cohort) host cost the fast path removed.  Guards that invariant the
+    way CL006 guards host syncs."""
+
+    id = "CL007"
+    title = "per-request serialization inside a hot fan-out loop"
+    hint = ("encode once before the loop and hand every send the shared "
+            "frame via request(body=...) — see comm/downlink."
+            "DownlinkEncoder; mark a justified per-iteration encode with "
+            "`# colearn: noqa(CL007)`")
+
+    _ENCODERS = {"pytree_to_bytes", "save_pytree_npz"}
+    # Fan-outs submit via comprehensions as often as statement loops.
+    _LOOPS = (ast.For, ast.While, ast.ListComp, ast.SetComp, ast.DictComp,
+              ast.GeneratorExp)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        hot = ctx.hot_lines()
+        if not hot:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, self._LOOPS) and node.lineno in hot):
+                continue
+            for inner in ast.walk(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                tail = dotted_name(inner.func).rsplit(".", 1)[-1]
+                if tail in self._ENCODERS:
+                    yield self.finding(
+                        ctx, inner,
+                        f"{tail}() inside a `# colearn: hot` fan-out loop "
+                        "re-encodes the full model per request; encode "
+                        "once and pass request(body=...)")

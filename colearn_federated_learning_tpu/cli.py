@@ -82,6 +82,11 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--compress", default=None,
                    choices=["none", "int8", "topk"],
                    help="update compression on the wire/file planes")
+    p.add_argument("--compress-down", default=None,
+                   choices=["none", "int8", "topk"],
+                   help="DOWNLINK broadcast compression (synchronous "
+                        "coordinator): ship the server delta against a "
+                        "worker-side param cache (comm/downlink.py)")
     p.add_argument("--straggler-prob", type=float, default=None)
     p.add_argument("--eval-every", type=int, default=None)
     p.add_argument("--log-every", type=int, default=None)
@@ -145,8 +150,9 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "prox_mu", "dp_clip", "dp_noise_multiplier", "dp_delta",
              "dp_adaptive_clip", "dp_target_quantile", "dp_clip_lr",
              "dp_bit_noise", "secure_agg", "secure_agg_neighbors",
-             "straggler_prob", "compress", "aggregator", "trim_fraction",
-             "edge_groups", "edge_sync_period", "min_cohort_fraction"}
+             "straggler_prob", "compress", "compress_down", "aggregator",
+             "trim_fraction", "edge_groups", "edge_sync_period",
+             "min_cohort_fraction"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _MODEL_KEYS = {"attn_impl", "remat", "stem", "norm", "width"}
 _RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
@@ -488,9 +494,17 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     else:
         plan = faults.canned_plan(
             seed=args.fault_seed if args.fault_seed is not None else 7)
+    config = None
+    if args.compress_down and args.compress_down != "none":
+        import dataclasses as _dc
+
+        config = faults.default_soak_config(args.num_workers)
+        config = _dc.replace(
+            config, fed=_dc.replace(config.fed,
+                                    compress_down=args.compress_down))
     summary = faults.run_soak(
         rounds=args.rounds, n_workers=args.num_workers, plan=plan,
-        round_timeout=args.round_timeout,
+        round_timeout=args.round_timeout, config=config,
         log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
     )
     print(json.dumps(summary))
@@ -697,11 +711,16 @@ def main(argv: list[str] | None = None) -> int:
     p_chaos.add_argument("--fault-seed", type=int, default=None)
     p_chaos.add_argument("--no-faults", action="store_true",
                          help="run the soak without any plan (baseline)")
+    p_chaos.add_argument("--compress-down", default=None,
+                         choices=["none", "int8", "topk"],
+                         help="soak with downlink delta compression on "
+                              "(exercises the cache-miss resync path "
+                              "under faults)")
     p_chaos.set_defaults(fn=cmd_chaos)
 
     p_lint = sub.add_parser("lint",
                             help="run the AST invariant checks "
-                                 "(CL001-CL006; analysis/) — fast, "
+                                 "(CL001-CL007; analysis/) — fast, "
                                  "CPU-only, no jax init")
     p_lint.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the installed "

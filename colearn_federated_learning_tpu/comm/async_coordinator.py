@@ -90,6 +90,13 @@ class AsyncFederatedCoordinator:
                 "per-device pumps don't have; use the synchronous "
                 "coordinator"
             )
+        if config.fed.compress_down != "none":
+            raise NotImplementedError(
+                "downlink delta compression (compress_down) is "
+                "synchronous-only: each async pump trains a different "
+                "model version, so there is no shared broadcast base to "
+                "delta against; use the synchronous coordinator"
+            )
         setup_lib.require_mean_aggregator(config, "the async coordinator")
         validate_robustness(config)
         self.config = config
@@ -114,6 +121,9 @@ class AsyncFederatedCoordinator:
         self.evaluator: Optional[DeviceInfo] = None
         self._clients: dict[str, TensorClient] = {}
         self._results: queue.Queue = queue.Queue()
+        # (version, params_np, encoded body) — every pump dispatching model
+        # version v shares ONE encoded frame (serialize-once per version).
+        self._snap_cache: Optional[tuple] = None
         self._state_lock = threading.Lock()
         self._version_cv = threading.Condition()
         self._stop = threading.Event()
@@ -163,12 +173,24 @@ class AsyncFederatedCoordinator:
 
     # ------------------------------------------------------------------
     def _snapshot(self):
-        """(version, params-as-numpy) under the state lock — dispatchers
-        must never read params mid-server-update."""
+        """(version, params-as-numpy, encoded frame) under the state lock —
+        dispatchers must never read params mid-server-update.  The frame is
+        encoded once per model VERSION and shared read-only by every pump
+        (``comm.broadcast_encode_total``), instead of once per dispatch."""
+        from colearn_federated_learning_tpu.utils.serialization import (
+            pytree_to_bytes,
+        )
+
         with self._state_lock:
-            return self.version, jax.tree.map(
-                np.asarray, self.server_state.params
-            )
+            v = self.version
+            if self._snap_cache is None or self._snap_cache[0] != v:
+                params_np = jax.tree.map(np.asarray,
+                                         self.server_state.params)
+                body = memoryview(pytree_to_bytes(params_np, {"round": v}))
+                telemetry.get_registry().counter(
+                    "comm.broadcast_encode_total").inc()
+                self._snap_cache = (v, params_np, body)
+            return self._snap_cache
 
     def _dispatch_loop(self, dev: DeviceInfo) -> None:
         """One device's pump: train on the freshest model, enqueue, repeat.
@@ -187,7 +209,7 @@ class AsyncFederatedCoordinator:
                     self._version_cv.wait(0.1)
             if self._stop.is_set():
                 return
-            v, params_np = self._snapshot()
+            v, _params_np, body = self._snapshot()
             try:
                 with self.tracer.span("dispatch_train",
                                       device=dev.device_id, version=v):
@@ -196,8 +218,7 @@ class AsyncFederatedCoordinator:
                             {"op": "train", "round": v},
                             self.tracer.current_context(),
                         ),
-                        params_np,
-                        meta={"round": v}, timeout=self.request_timeout,
+                        body=body, timeout=self.request_timeout,
                     )
                 if header.get("status") != "ok":
                     raise RuntimeError(header.get("error"))

@@ -27,6 +27,7 @@ import jax
 import numpy as np
 
 from colearn_federated_learning_tpu.comm.broker import BrokerClient
+from colearn_federated_learning_tpu.comm.downlink import DownlinkEncoder
 from colearn_federated_learning_tpu.comm.enrollment import (
     DeviceInfo,
     EnrollmentManager,
@@ -110,6 +111,18 @@ class FederatedCoordinator:
         # Consecutive failed rounds → evicted (RunConfig.evict_after,
         # validated >= 1 above).
         self.evict_after = config.run.evict_after
+        # One fan-out pool per coordinator lifetime (grown, never shrunk):
+        # per-round ThreadPoolExecutor construction was O(cohort) thread
+        # spawns on the round's critical path.
+        self._pool: Optional[cf.ThreadPoolExecutor] = None
+        self._pool_size = 0
+        # Asks whose futures could not be cancelled after a timeout keep
+        # running; they are tracked so their (already-closed) clients can
+        # drain without touching a reconnected device — see _fan_out.
+        self._abandoned: list[cf.Future] = []
+        # Round-broadcast encoder: serialize-once, optional downlink delta
+        # compression (fed.compress_down; "none" keeps the wire identical).
+        self._downlink = DownlinkEncoder(config.fed.compress_down)
         self._ckpt = None
         # RDP accounting mirrors the engine's; each round is charged with
         # the ACTUAL cohort fraction and REALIZED noise (membership is
@@ -136,6 +149,9 @@ class FederatedCoordinator:
     def close(self) -> None:
         for c in self._clients.values():
             c.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
         self._broker.close()
         if self._ckpt is not None:
             self._ckpt.close()
@@ -197,35 +213,84 @@ class FederatedCoordinator:
                 "comm.reconnect_failures_total").inc()
 
     def _request(self, dev: DeviceInfo, header: dict, tree=None, meta=None,
-                 deadline=None):
+                 deadline=None, body=None):
         """One device request under the coordinator's retry policy.  The
         per-attempt timeout is whatever remains of the shared ``deadline``
         (never more than round_timeout), so retries cannot stack past the
-        round's one budget."""
+        round's one budget.  ``body`` is the serialize-once path: a shared
+        pre-encoded frame instead of a per-request ``tree`` encode."""
         return self._clients[dev.device_id].request(
             header, tree, meta=meta, timeout=self.round_timeout,
-            retry=self.retry, deadline=deadline,
+            retry=self.retry, deadline=deadline, body=body,
         )
 
-    def _fan_out(self, devs, ask):
+    def _executor(self, n: int) -> cf.ThreadPoolExecutor:
+        """The persistent fan-out pool, grown to at least ``n`` workers.
+        Growth replaces the pool (stdlib pools cannot resize); the old
+        pool's threads finish any abandoned asks they still hold and then
+        exit — shutdown(wait=False) never blocks the round."""
+        if self._pool is None or self._pool_size < n:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            self._pool_size = max(1, n)
+            self._pool = cf.ThreadPoolExecutor(
+                max_workers=self._pool_size, thread_name_prefix="fanout")
+        return self._pool
+
+    def _fan_out(self, devs, ask, on_result=None):
         """Fan ``ask`` out over ``devs`` racing ONE shared round_timeout
         deadline (sequential per-future timeouts would stack; each ask's
-        retries are budgeted against the same deadline).  Failures are
-        cancelled and the device's socket is RECONNECTED — a late reply
-        on the old socket would desynchronise the request/reply stream.
-        Returns (results, failed_devices)."""
-        results, failed = [], []
+        retries are budgeted against the same deadline).
+
+        Replies are consumed AS THEY ARRIVE (``cf.as_completed``) on this
+        collector thread; ``on_result(dev, result)`` runs per arrival —
+        the streaming-aggregation hook, single-threaded so folders need no
+        locking.  A failed or too-slow device's socket is RECONNECTED — a
+        late reply on the old socket would desynchronise the request/reply
+        stream.  ``fut.cancel()`` cannot stop an ask that is already
+        RUNNING, so un-cancellable futures are kept in ``_abandoned``
+        (pruned once done) instead of pretending they stopped: the ask
+        holds the OLD closed client, whose ``closed`` flag makes any
+        retry/reconnect abort instead of touching the replacement
+        connection.  Returns (results, failed_devices), ``failed`` in
+        ``devs`` order."""
+        self._abandoned = [f for f in self._abandoned if not f.done()]
+        results, failed_ids, handled = [], set(), set()
         deadline = time.monotonic() + self.round_timeout
-        with cf.ThreadPoolExecutor(max_workers=max(1, len(devs))) as pool:
-            futs = {pool.submit(ask, d, deadline): d for d in devs}
-            for fut, dev in futs.items():
-                try:
-                    remaining = max(0.0, deadline - time.monotonic())
-                    results.append(fut.result(timeout=remaining))
-                except Exception:
-                    fut.cancel()
-                    failed.append(dev)
-                    self._reconnect(dev)
+        pool = self._executor(len(devs))
+        futs = {pool.submit(ask, d, deadline): d for d in devs}  # colearn: hot
+
+        def take(fut, dev):
+            handled.add(fut)
+            try:
+                res = fut.result()
+            except Exception:
+                failed_ids.add(dev.device_id)
+                self._reconnect(dev)
+                return
+            if on_result is not None:
+                on_result(dev, res)
+            results.append(res)
+
+        try:
+            for fut in cf.as_completed(futs, timeout=self.round_timeout):
+                take(fut, futs[fut])
+        except cf.TimeoutError:   # colearn: noqa(CL003)
+            pass  # stragglers handled below: dropped, counted, reconnected
+        for fut, dev in futs.items():
+            if fut in handled:
+                continue
+            if fut.done():
+                # Completed in the race window after as_completed gave up;
+                # its reply is here, so use it (same leniency the old
+                # barrier's fut.result(timeout=0) had for done futures).
+                take(fut, dev)
+                continue
+            if not fut.cancel():
+                self._abandoned.append(fut)
+            failed_ids.add(dev.device_id)
+            self._reconnect(dev)
+        failed = [d for d in devs if d.device_id in failed_ids]
         return results, failed
 
     def _sample_cohort(self, round_idx: int) -> list[DeviceInfo]:
@@ -270,39 +335,73 @@ class FederatedCoordinator:
         ctx = self.tracer.current_context()
         with self.tracer.span("serialize_params"):
             params_np = jax.tree.map(np.asarray, self.server_state.params)
+            # ONE encode + crc for the whole cohort (serialize-once): every
+            # send below shares this read-only frame.  With compress_down
+            # the frame is the server delta; ``resync_body`` lazily encodes
+            # full params for workers whose cache missed the delta's base.
+            body, resync_body, saved = self._downlink.encode_round(
+                r, params_np)
         secure = self.config.fed.secure_agg
         cohort_ids = sorted(int(d.device_id) for d in cohort)
+        reg = telemetry.get_registry()
 
-        def ask(dev: DeviceInfo, deadline: float):
+        def train_req():
             req = protocol.attach_trace({"op": "train", "round": r}, ctx)
             if secure:
                 req["cohort"] = cohort_ids
-            header, delta = self._request(dev, req, params_np,
-                                          meta={"round": r},
+            return req
+
+        def ask(dev: DeviceInfo, deadline: float):
+            header, delta = self._request(dev, train_req(), body=body,
                                           deadline=deadline)
+            if header.get("status") == "resync" and resync_body is not None:
+                # Cache miss on the worker (restart / skipped round): pay
+                # one full-params send for THIS device; the rest of the
+                # cohort keeps the compressed frame.
+                reg.counter("comm.resync_total").inc()
+                header, delta = self._request(dev, train_req(),
+                                              body=resync_body(),
+                                              deadline=deadline)
+            elif saved:
+                reg.counter("comm.bytes_saved_downlink").inc(saved)
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"], delta
 
-        with self.tracer.span("broadcast_collect",
-                              cohort=len(cohort)) as collect_sp:
-            results, failed = self._fan_out(cohort, ask)
-        dropped = [d.device_id for d in failed]
-
         from colearn_federated_learning_tpu.comm.aggregation import (
-            UpdateFolder,
+            StreamingFolder,
         )
 
+        # Fold order (hence every float sum) is pinned to COHORT order by
+        # the StreamingFolder regardless of reply timing, so streaming
+        # changes round records not at all — see StreamingFolder docstring.
+        folder = StreamingFolder(
+            params_np, order=[str(int(d.device_id)) for d in cohort])
+        stale: list[str] = []
+
+        def fold(dev: DeviceInfo, res) -> None:
+            meta, delta = res
+            _pop_worker_spans(meta, self.tracer)
+            if int(meta.get("round", r)) != r:   # stale update: refuse
+                stale.append(str(meta.get("client_id")))
+                return
+            folder.add(meta, delta)
+
+        with self.tracer.span("broadcast_collect",
+                              cohort=len(cohort)) as collect_sp:
+            results, failed = self._fan_out(cohort, ask, on_result=fold)
+        dropped = [d.device_id for d in failed]
+
         with self.tracer.span("aggregate") as agg_sp:
-            folder = UpdateFolder(params_np)
-            received = []
-            for meta, delta in results:
-                _pop_worker_spans(meta, self.tracer)
-                if int(meta.get("round", r)) != r:   # stale update: refuse
-                    dropped.append(str(meta.get("client_id")))
-                    continue
-                folder.add(meta, delta)
-                received.append(int(meta["client_id"]))
+            folder.finalize()
+            if stale:
+                # Deterministic order for the record: cohort position, not
+                # reply-arrival order.
+                pos = {str(int(d.device_id)): i
+                       for i, d in enumerate(cohort)}
+                dropped.extend(sorted(stale,
+                                      key=lambda c: pos.get(c, len(pos))))
+            received = [int(c) for c in folder.folded_ids]
             folded = folder.count
 
             # Aggregation quorum: a sub-quorum round is an explicit no-op
@@ -353,6 +452,9 @@ class FederatedCoordinator:
             "total_weight": total_w,
             "phase_broadcast_collect_s": collect_sp.duration_s,
             "phase_aggregate_s": agg_sp.duration_s,
+            # Decompress/convert/scale time the streaming fold overlapped
+            # with stragglers — work that used to run AFTER the barrier.
+            "phase_fold_overlap_s": folder.fold_s,
         }
         if secure:
             rec["unmask_failed"] = unmask_failed
@@ -416,8 +518,17 @@ class FederatedCoordinator:
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"], mask
 
-        results, failed = self._fan_out(devs, ask)
-        for meta, mask in results:
+        # Collect per device, then subtract in ``devs`` (= received) order:
+        # the float subtraction order must not depend on reply timing.
+        got: dict[str, tuple] = {}
+        _, failed = self._fan_out(
+            devs, ask, on_result=lambda dev, res: got.__setitem__(
+                dev.device_id, res))
+        for dev in devs:
+            res = got.get(dev.device_id)
+            if res is None:
+                continue
+            meta, mask = res
             _pop_worker_spans(meta, self.tracer)
             if int(meta.get("n_dropped_pairs", 0)) == 0 or mask is None:
                 continue
@@ -436,19 +547,33 @@ class FederatedCoordinator:
                 "per-client evaluation is disabled under secure_agg: "
                 "per-client statistics are exactly what the masks hide"
             )
+        from colearn_federated_learning_tpu.utils.serialization import (
+            pytree_to_bytes,
+        )
+
         params_np = jax.tree.map(np.asarray, self.server_state.params)
+        # Serialize-once here too: one shared frame for the whole fan-out.
+        body = memoryview(pytree_to_bytes(params_np))
+        telemetry.get_registry().counter("comm.broadcast_encode_total").inc()
         ctx = self.tracer.current_context()
 
         def ask(dev: DeviceInfo, deadline: float):
             header, _ = self._request(
                 dev, protocol.attach_trace({"op": "self_eval"}, ctx),
-                params_np, deadline=deadline,
+                body=body, deadline=deadline,
             )
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
             return header["meta"]
 
-        metas, _ = self._fan_out(self.trainers, ask)
+        # Collect per device, then summarize in trainer order — the
+        # weighted sums below must not depend on reply-arrival order.
+        got: dict[str, dict] = {}
+        self._fan_out(self.trainers, ask,
+                      on_result=lambda dev, m: got.__setitem__(
+                          dev.device_id, m))
+        metas = [got[d.device_id] for d in self.trainers
+                 if d.device_id in got]
         for m in metas:
             _pop_worker_spans(m, self.tracer)
         if not metas:

@@ -1,0 +1,178 @@
+"""Downlink delta compression for the socket broadcast (wire fast path).
+
+The uplink has compressed client deltas since fed/compression.py landed,
+but the coordinator still shipped FULL uncompressed params to every
+cohort member every round — at the IoT edge the downlink is half the
+round's bytes.  This module closes that gap bidirectionally (the Aji &
+Heafield 2017 update-compression direction, PAPERS.md):
+
+- the coordinator broadcasts the SERVER DELTA (params_r − base_{r-1})
+  through the existing ``int8``/``topk`` codecs (``FedConfig
+  .compress_down``; ``none`` — the default — keeps the wire byte-identical
+  to the pre-compression build);
+- every worker caches the last global params it applied, keyed by round
+  (:class:`WorkerParamCache`), and reconstructs ``base + delta``;
+- the codecs are lossy, so the coordinator tracks the RECONSTRUCTED
+  params the workers actually hold and diffs against THOSE (implicit
+  error feedback: this round's quantization residual rides into the next
+  round's delta instead of accumulating as silent drift);
+- a cache miss or round gap (worker restart, re-enrollment, a
+  flap/drop that skipped a round — any faults/ scenario) makes the worker
+  reply ``status="resync"`` and the coordinator re-send the full
+  reconstructed params for the round, so every worker converges on the
+  SAME bytes no matter how it rejoined.  Resyncs are counted in
+  ``comm.resync_total``; per-send byte savings in
+  ``comm.bytes_saved_downlink``.
+
+Synchronous-coordinator only: the async dispatcher pumps run one model
+version per device with no shared base, so they broadcast full params
+(still serialize-once per version).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.fed import compression
+from colearn_federated_learning_tpu.utils.serialization import (
+    pytree_to_bytes,
+    wire_frame_length,
+)
+
+# Broadcast meta slots (CLW1 frame meta, alongside "round").
+DOWN_KEY = "down"            # "full" | "delta"; absent = plain broadcast
+DOWN_BASE_KEY = "down_base"  # round whose cached params the delta is against
+MODE_FULL = "full"
+MODE_DELTA = "delta"
+
+
+def apply_dense_delta(base: Any, delta: Any) -> Any:
+    """``base + delta`` leafwise, float32 accumulation, base dtypes kept
+    (decompressed deltas are float32; params may be bfloat16).  The
+    coordinator and every worker run this SAME function on identical
+    arrays, so their reconstructions agree bitwise."""
+    def add(b, d):
+        b = np.asarray(b)
+        return (b.astype(np.float32)
+                + np.asarray(d, np.float32)).astype(b.dtype)
+
+    return jax.tree.map(add, base, delta)
+
+
+class DownlinkEncoder:
+    """Per-round broadcast encoder (coordinator side): one CLW1 encode per
+    round — counted in ``comm.broadcast_encode_total`` — whose frame is
+    shared read-only across every cohort send (serialize-once)."""
+
+    def __init__(self, scheme: str = "none"):
+        if scheme not in compression.SCHEMES:
+            raise ValueError(
+                f"unknown compress_down {scheme!r} "
+                f"(use {compression.SCHEMES})"
+            )
+        self.scheme = scheme
+        # (round, reconstructed params) — what the workers' caches hold.
+        self._base: Optional[tuple[int, Any]] = None
+
+    def encode_round(
+        self, r: int, params_np: Any
+    ) -> tuple[memoryview, Optional[Callable[[], memoryview]], int]:
+        """Encode round ``r``'s broadcast body.
+
+        Returns ``(body, resync_body, bytes_saved_per_send)``:
+        ``body`` is the shared frame every cohort send uses; ``resync_body``
+        (None when the scheme is off) lazily encodes — at most once — the
+        full reconstructed params for workers that answered "resync";
+        ``bytes_saved_per_send`` is the payload shrink a delta send
+        realizes over a full-params send."""
+        reg = telemetry.get_registry()
+        if self.scheme == "none":
+            # Byte-identical to the per-request encode this path replaced.
+            body = pytree_to_bytes(params_np, {"round": r})
+            reg.counter("comm.broadcast_encode_total").inc()
+            return memoryview(body), None, 0
+
+        if self._base is None:
+            meta = {"round": r, DOWN_KEY: MODE_FULL}
+            body = pytree_to_bytes(params_np, meta)
+            reg.counter("comm.broadcast_encode_total").inc()
+            self._base = (r, params_np)
+            return memoryview(body), self._resync_fn(r, params_np), 0
+
+        base_round, base = self._base
+        delta = jax.tree.map(
+            lambda a, b: np.asarray(a, np.float32) - np.asarray(b, np.float32),
+            params_np, base,
+        )
+        wire, cmeta = compression.compress_delta(delta, self.scheme)
+        meta = {"round": r, DOWN_KEY: MODE_DELTA, DOWN_BASE_KEY: base_round,
+                **cmeta}
+        body = pytree_to_bytes(wire, meta)
+        reg.counter("comm.broadcast_encode_total").inc()
+        recon = apply_dense_delta(
+            base, compression.decompress_delta(wire, cmeta, shapes=base)
+        )
+        self._base = (r, recon)
+        # Frame-vs-frame: what a full-params broadcast WOULD have cost on
+        # the wire this round, minus what the delta frame actually costs.
+        full_len = wire_frame_length(
+            params_np, {"round": r, DOWN_KEY: MODE_FULL})
+        saved = max(0, full_len - len(body))
+        return memoryview(body), self._resync_fn(r, recon), saved
+
+    def _resync_fn(self, r: int, recon: Any) -> Callable[[], memoryview]:
+        """Lazy one-shot encoder for the round's full-params resync body.
+        Encoded only if some worker actually needs it, at most once per
+        round (concurrent resyncs share the frame), and it ships the
+        RECONSTRUCTED params — the exact bytes the rest of the cohort
+        derived — so a rejoining worker's cache matches its peers'."""
+        lock = threading.Lock()
+        cache: list[memoryview] = []
+
+        def resync_body() -> memoryview:
+            with lock:
+                if not cache:
+                    telemetry.get_registry().counter(
+                        "comm.broadcast_encode_total").inc()
+                    cache.append(memoryview(pytree_to_bytes(
+                        recon, {"round": r, DOWN_KEY: MODE_FULL})))
+                return cache[0]
+
+        return resync_body
+
+
+class WorkerParamCache:
+    """Worker-side cache of the last applied global params, keyed by
+    round.  ``resolve`` returns the round's full params (applying a delta
+    against the cache when the broadcast is compressed) or ``None`` when
+    the worker must request a full-params resync."""
+
+    def __init__(self) -> None:
+        self._round: Optional[int] = None
+        self._params: Any = None
+
+    def resolve(self, round_idx: int, meta: dict, tree: Any) -> Any:
+        mode = meta.get(DOWN_KEY)
+        if mode == MODE_DELTA:
+            if self._round == round_idx:
+                # Transport retry of a round we already applied (the reply
+                # was lost, not the request): idempotent.
+                return self._params
+            base = meta.get(DOWN_BASE_KEY)
+            if self._params is None or self._round != base:
+                return None          # restart / skipped round → resync
+            delta = compression.decompress_delta(
+                tree, meta, shapes=self._params
+            )
+            params = apply_dense_delta(self._params, delta)
+            self._round, self._params = round_idx, params
+            return params
+        # MODE_FULL (or a plain broadcast while caching is active).
+        params = jax.tree.map(np.asarray, tree)
+        self._round, self._params = round_idx, params
+        return params

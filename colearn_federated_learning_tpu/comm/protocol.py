@@ -80,16 +80,25 @@ class CorruptFrame(ValueError):
     connection must be dropped, not re-read."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes into one preallocated buffer.
+
+    ``recv_into`` a sliding memoryview, so a multi-chunk body costs one
+    allocation and zero reassembly copies (the old recv-and-extend loop
+    reallocated and memmoved the accumulator as it grew — measurable at
+    model-frame sizes).  Returns the bytearray itself; callers treat it
+    as read-only bytes."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         # The per-read deadline is the caller's settimeout (BrokerClient
         # drains via a reader thread; TensorServer sets a serve timeout).
-        chunk = sock.recv(min(n - len(buf), 1 << 20))  # colearn: noqa(CL002)
-        if not chunk:
-            raise ConnectionClosed(f"peer closed after {len(buf)}/{n} bytes")
-        buf.extend(chunk)
-    return bytes(buf)
+        r = sock.recv_into(view[got:], n - got)  # colearn: noqa(CL002)
+        if not r:
+            raise ConnectionClosed(f"peer closed after {got}/{n} bytes")
+        got += r
+    return buf
 
 
 def frame_crc(hdr: bytes, body: bytes) -> int:
@@ -101,14 +110,29 @@ def _corrupt(msg: str) -> CorruptFrame:
     return CorruptFrame(f"corrupt frame: {msg}")
 
 
-def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
+def send_msg(sock: socket.socket, header: dict, body=b"") -> None:
+    """``body`` is any bytes-like object (bytes / bytearray / memoryview)
+    — the coordinator passes one shared read-only frame to every cohort
+    send (serialize-once broadcast), so this must never copy it."""
     hdr = json.dumps(header, separators=(",", ":")).encode()
     if len(hdr) > MAX_HEADER:
         raise ValueError(f"header too large: {len(hdr)}")
-    sock.sendall(_HDR.pack(len(hdr)) + hdr
-                 + _BODY.pack(len(body), frame_crc(hdr, body)))
+    prefix = (_HDR.pack(len(hdr)) + hdr
+              + _BODY.pack(len(body), frame_crc(hdr, body)))
     if body:
-        sock.sendall(body)
+        # One vectored syscall for prefix+body instead of two sendalls
+        # (saves a syscall + a small-segment wakeup per message).  sendmsg
+        # may send partially; finish the tail with sendall on views.
+        sent = sock.sendmsg([prefix, body])
+        total = len(prefix) + len(body)
+        if sent < total:
+            if sent < len(prefix):
+                sock.sendall(memoryview(prefix)[sent:])
+                sock.sendall(body)
+            else:
+                sock.sendall(memoryview(body)[sent - len(prefix):])
+    else:
+        sock.sendall(prefix)
     reg = _metrics.get_registry()
     reg.counter("comm.messages_sent").inc()
     reg.counter("comm.bytes_sent").inc(

@@ -221,26 +221,46 @@ class TensorClient:
         self._host, self._port = host, port
         self.ident = ident or f"{host}:{port}"
         self._rng = random.Random(zlib.crc32(self.ident.encode()))
+        self.closed = False
         self._sock = protocol.connect(host, port, timeout=timeout)
 
     def _reconnect(self, timeout: Optional[float]) -> None:
         protocol.close_quietly(self._sock)
+        if self.closed:
+            # An abandoned fan-out ask must not resurrect a connection the
+            # coordinator already replaced: its ghost request would hit the
+            # worker concurrently with the next round's on the new client.
+            raise protocol.ConnectionClosed(f"{self.ident}: client closed")
         self._sock = protocol.connect(self._host, self._port, timeout=timeout)
 
     def request(self, header: dict, tree: Any = None,
                 meta: Optional[dict] = None,
                 timeout: Optional[float] = None,
                 retry: Optional[RetryPolicy] = None,
-                deadline: Optional[float] = None) -> tuple[dict, Any]:
+                deadline: Optional[float] = None,
+                body: Any = None) -> tuple[dict, Any]:
         """One round trip.  Raises ``TimeoutError``/``OSError`` on a dead or
         too-slow peer — the coordinator treats that as a straggler drop.
+
+        ``body`` is an optional PRE-ENCODED CLW1 frame (any bytes-like,
+        shared read-only across calls): the serialize-once broadcast path.
+        The coordinator encodes the round's params frame once and hands the
+        same buffer to every cohort send, instead of paying a full-model
+        encode + crc32 per device per round here.  Mutually exclusive with
+        ``tree``/``meta``.
 
         With ``retry``, transient transport failures are retried on a
         fresh socket (a failed socket may hold a late half-frame that
         would desynchronise the stream).  ``deadline`` is an absolute
         ``time.monotonic()`` instant shared by every attempt AND backoff
         sleep, so retrying never extends the caller's one budget."""
-        body = pytree_to_bytes(tree, meta) if tree is not None else b""
+        if body is None:
+            body = pytree_to_bytes(tree, meta) if tree is not None else b""
+        elif tree is not None:
+            raise ValueError("pass either a pre-encoded body or a tree, "
+                             "not both")
+        if self.closed:
+            raise protocol.ConnectionClosed(f"{self.ident}: client closed")
         attempts = 1 + (retry.max_retries if retry is not None else 0)
         # Labeled per peer: the aggregate still counts every retry, and
         # the {device=...} children answer "who is flaky?" in snapshots.
@@ -290,4 +310,8 @@ class TensorClient:
         return out_header, out_tree
 
     def close(self) -> None:
+        # Flag BEFORE closing: a concurrent (abandoned) request that hits
+        # the dying socket sees the flag and aborts instead of retrying
+        # onto a fresh connection.
+        self.closed = True
         protocol.close_quietly(self._sock)

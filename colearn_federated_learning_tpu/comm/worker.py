@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from colearn_federated_learning_tpu.comm.broker import BrokerClient
+from colearn_federated_learning_tpu.comm import downlink
 from colearn_federated_learning_tpu.comm import enrollment
 from colearn_federated_learning_tpu.comm import protocol
 from colearn_federated_learning_tpu.comm.transport import TensorServer
@@ -142,6 +143,9 @@ class DeviceWorker:
         self.role: Optional[str] = None
         self._watch_stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
+        # Last-applied global params, engaged the first time a broadcast
+        # carries a downlink mode (coordinator runs compress_down).
+        self._param_cache: Optional[downlink.WorkerParamCache] = None
 
     # ------------------------------------------------------------------
     @property
@@ -282,7 +286,8 @@ class DeviceWorker:
     def _dispatch(self, op, header: dict, tree: Any) -> tuple[dict, Any]:
         if op == "train":
             return self._train(int(header.get("round", 0)), tree,
-                               cohort=header.get("cohort"))
+                               cohort=header.get("cohort"),
+                               meta=header.get("meta"))
         if op == "unmask":
             return self._unmask(int(header.get("round", 0)),
                                 header.get("dropped", []),
@@ -368,10 +373,35 @@ class DeviceWorker:
         return (jnp.asarray(np.stack(keys)),
                 jnp.asarray(np.asarray(signs, np.float32)))
 
+    def _resolve_params(self, round_idx: int, meta: Optional[dict],
+                        tree: Any) -> Any:
+        """Materialize the round's full global params from a broadcast.
+
+        Plain broadcasts (no downlink mode in ``meta``) pass through
+        untouched — zero cost when compress_down is off.  Compressed
+        broadcasts engage the :class:`downlink.WorkerParamCache`; ``None``
+        means the cache cannot reconstruct (restart / skipped round) and
+        the caller must answer with a resync request."""
+        mode = meta.get(downlink.DOWN_KEY) if meta else None
+        if mode is None and self._param_cache is None:
+            return tree
+        if self._param_cache is None:
+            self._param_cache = downlink.WorkerParamCache()
+        return self._param_cache.resolve(round_idx, meta or {}, tree)
+
     def _train(self, round_idx: int, global_params: Any,
-               cohort=None) -> tuple[dict, Any]:
+               cohort=None, meta=None) -> tuple[dict, Any]:
         with self.tracer.span("deserialize_params"):
-            params = jax.tree.map(jnp.asarray, global_params)
+            full = self._resolve_params(round_idx, meta, global_params)
+            if full is None:
+                # Explicit cache-miss reply: the coordinator re-sends full
+                # params (comm.resync_total) instead of this device
+                # training on garbage or silently dropping out.
+                return ({"status": "resync",
+                         "error": f"client {self.client_id} has no cached "
+                                  f"base for round {round_idx} delta"},
+                        None)
+            params = jax.tree.map(jnp.asarray, full)
         with self.tracer.span("local_train", steps=self._num_steps):
             result = self._update_fn(
                 params, self._x, self._y, self._count,

@@ -69,6 +69,12 @@ def validate_robustness(config: "ExperimentConfig") -> None:
             "worker_enroll_timeout must be positive, got "
             f"{run.worker_enroll_timeout}"
         )
+    from colearn_federated_learning_tpu.fed.compression import SCHEMES
+
+    if fed.compress_down not in SCHEMES:
+        raise ValueError(
+            f"unknown compress_down {fed.compress_down!r} (use {SCHEMES})"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,6 +181,11 @@ class FedConfig:
     secure_agg_key_exchange: str = "dh"   # dh | shared_seed
     # Update compression on the wire/file planes (fed/compression.py).
     compress: str = "none"            # none | int8 | topk
+    # DOWNLINK compression (synchronous coordinator broadcast): ship the
+    # server delta through the same codecs against a worker-side param
+    # cache (comm/downlink.py).  "none" keeps the broadcast byte-identical
+    # to builds without the feature.
+    compress_down: str = "none"       # none | int8 | topk
     # Aggregation quorum for the socket coordinators: a round whose
     # completed-update count falls below ceil(fraction * cohort) becomes
     # an explicit no-op (the secure-agg discarded-round convention)

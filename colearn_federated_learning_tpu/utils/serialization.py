@@ -31,6 +31,32 @@ _WIRE_HLEN = struct.Struct(">I")
 _WIRE_PAY = struct.Struct(">QI")      # payload length, crc32
 
 
+def _dtype_entry(dtype: np.dtype) -> dict:
+    """Leaf-directory dtype slots.  ``dtype.str`` is authoritative for
+    every builtin dtype, but ml_dtypes extension types (bfloat16,
+    float8_*) all stringify as raw void bytes (``'<V2'``) — decoding that
+    silently reinterprets the payload.  Those get an explicit dtype-NAME
+    slot (``"n"``) the decoder resolves by name instead."""
+    entry = {"d": dtype.str}
+    if np.dtype(dtype.str) != dtype:
+        entry["n"] = dtype.name
+    return entry
+
+
+def _resolve_dtype(entry: dict) -> np.dtype:
+    name = entry.get("n")
+    if name is None:
+        return np.dtype(entry["d"])
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # Extension dtypes register with numpy on import; a decoder
+        # process that never touched jax/ml_dtypes needs the import first.
+        import ml_dtypes  # noqa: F401
+
+        return np.dtype(name)
+
+
 def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
     out: dict[str, np.ndarray] = {}
     if isinstance(tree, dict):
@@ -62,25 +88,53 @@ def _unflatten(flat: dict[str, np.ndarray]) -> Any:
     return tree
 
 
+_NPZ_DTYPES = "__dtypes__"
+
+
 def save_pytree_npz(path_or_file, tree: Any, meta: dict | None = None) -> None:
     flat = _flatten(tree)
-    flat[_META] = np.frombuffer(
+    # npz stores extension dtypes (bfloat16, ...) as raw void bytes with no
+    # way back; ship those leaves as flat byte views plus a (name, shape)
+    # map the loader re-views through (same pitfall as the CLW1 "n" slot).
+    views = {}
+    names = {}
+    for p, a in flat.items():
+        if np.dtype(a.dtype.str) != a.dtype:
+            names[p] = [a.dtype.name, list(a.shape)]
+            views[p] = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+        else:
+            views[p] = a
+    views[_META] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8
     ).copy()
-    np.savez(path_or_file, **flat)
+    if names:
+        views[_NPZ_DTYPES] = np.frombuffer(
+            json.dumps(names).encode(), dtype=np.uint8
+        ).copy()
+    np.savez(path_or_file, **views)
 
 
 def load_pytree_npz(path_or_file) -> tuple[Any, dict]:
     z = np.load(path_or_file)
     meta = json.loads(bytes(z[_META]).decode()) if _META in z.files else {}
-    flat = {k: z[k] for k in z.files if k != _META}
+    names = (json.loads(bytes(z[_NPZ_DTYPES]).decode())
+             if _NPZ_DTYPES in z.files else {})
+    flat = {}
+    for k in z.files:
+        if k in (_META, _NPZ_DTYPES):
+            continue
+        arr = z[k]
+        if k in names:
+            name, shape = names[k]
+            arr = arr.view(_resolve_dtype({"n": name})).reshape(shape)
+        flat[k] = arr
     return _unflatten(flat), meta
 
 
 def pytree_to_bytes(tree: Any, meta: dict | None = None) -> bytearray:
     """Encode as a ``CLW1`` wire frame (the transport's format)."""
     flat = {p: np.ascontiguousarray(a) for p, a in _flatten(tree).items()}
-    entries = [{"p": p, "d": a.dtype.str, "s": list(a.shape)}
+    entries = [{"p": p, "s": list(a.shape), **_dtype_entry(a.dtype)}
                for p, a in flat.items()]
     header = json.dumps({"leaves": entries, "meta": meta or {}},
                         separators=(",", ":")).encode()
@@ -111,6 +165,20 @@ def pytree_to_bytes(tree: Any, meta: dict | None = None) -> bytearray:
     return out                        # bytes-like; avoids a full-frame copy
 
 
+def wire_frame_length(tree: Any, meta: dict | None = None) -> int:
+    """Exact length of the ``CLW1`` frame :func:`pytree_to_bytes` would
+    produce, WITHOUT building it — header JSON only, no payload copy.
+    Lets the downlink compressor report true bytes-saved (frame vs frame,
+    not raw-leaf-bytes vs frame) at negligible cost."""
+    flat = _flatten(tree)
+    entries = [{"p": p, "s": list(a.shape) or [1], **_dtype_entry(a.dtype)}
+               for p, a in flat.items()]   # `or [1]`: 0-d leaves encode (1,)
+    header = json.dumps({"leaves": entries, "meta": meta or {}},
+                        separators=(",", ":")).encode()
+    return (len(_WIRE_MAGIC) + _WIRE_HLEN.size + len(header)
+            + _WIRE_PAY.size + sum(a.nbytes for a in flat.values()))
+
+
 def _wire_to_pytree(data: bytes) -> tuple[Any, dict]:
     off = len(_WIRE_MAGIC)
     (hlen,) = _WIRE_HLEN.unpack_from(data, off)
@@ -125,7 +193,7 @@ def _wire_to_pytree(data: bytes) -> tuple[Any, dict]:
     flat: dict[str, np.ndarray] = {}
     pos = 0
     for e in header["leaves"]:
-        dtype = np.dtype(e["d"])
+        dtype = _resolve_dtype(e)
         shape = tuple(e["s"])
         n = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
         # copy() detaches each leaf from the big frame buffer (and makes it

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Wire-plane round micro-bench: serialize-once broadcast + downlink delta.
+
+Runs an in-process federation (MessageBroker + DeviceWorkers +
+FederatedCoordinator — the chaos-soak topology, minus the faults) over
+the bench CNN shape and measures, per round:
+
+- ``comm.broadcast_encode_total`` delta — MUST be exactly 1 regardless of
+  cohort size (the pre-PR path encoded the full model once per request,
+  i.e. ``cohort`` times; that analytic "before" is recorded alongside);
+- ``comm.bytes_sent`` / ``comm.bytes_saved_downlink`` deltas and the
+  resulting downlink frame-vs-frame reduction with ``--compress-down``;
+- round latency and the streaming-fold overlap
+  (``phase_fold_overlap_s``).
+
+One JSON summary line per (cohort, scheme) configuration is appended to
+``results/wire_bench.jsonl`` (PERF.md "Wire plane" reads from there).
+
+Usage (CPU):
+    JAX_PLATFORMS=cpu python scripts/bench_wire.py
+    JAX_PLATFORMS=cpu python scripts/bench_wire.py \\
+        --cohorts 2,4 --schemes none,int8 --rounds 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from colearn_federated_learning_tpu import telemetry  # noqa: E402
+from colearn_federated_learning_tpu.utils.config import (  # noqa: E402
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+# Counters sampled as per-round deltas.
+_COUNTERS = (
+    "comm.broadcast_encode_total",
+    "comm.bytes_sent",
+    "comm.bytes_saved_downlink",
+    "comm.resync_total",
+)
+
+
+def bench_config(n_workers: int, scheme: str) -> ExperimentConfig:
+    """The bench CNN shape: a width-16 conv net on mnist_tiny — big enough
+    (~100 kB of float32 params) that frame encode/copy costs are visible,
+    small enough to compile and train in seconds on CPU."""
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=n_workers,
+                        partition="iid"),
+        model=ModelConfig(name="cnn", num_classes=10, width=16),
+        fed=FedConfig(strategy="fedavg", rounds=1, cohort_size=0,
+                      local_steps=2, batch_size=16, lr=0.05, momentum=0.0,
+                      compress_down=scheme),
+        run=RunConfig(name="bench_wire", backend="cpu", seed=0),
+    )
+
+
+def run_bench(n_workers: int, scheme: str, rounds: int,
+              warmup_timeout: float, round_timeout: float) -> dict:
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+    from colearn_federated_learning_tpu.comm.coordinator import (
+        FederatedCoordinator,
+    )
+    from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+    from colearn_federated_learning_tpu.utils.serialization import (
+        wire_frame_length,
+    )
+
+    import jax
+    import numpy as np
+
+    config = bench_config(n_workers, scheme)
+    reg = telemetry.get_registry()
+
+    broker = MessageBroker().start()
+    workers = []
+    coord = None
+    per_round: list[dict] = []
+    try:
+        workers = [
+            DeviceWorker(config, i, broker.host, broker.port).start()
+            for i in range(n_workers)
+        ]
+        coord = FederatedCoordinator(config, broker.host, broker.port,
+                                     round_timeout=warmup_timeout,
+                                     want_evaluator=False)
+        coord.enroll(min_devices=n_workers, timeout=30.0)
+        coord.trainers.sort(key=lambda d: int(d.device_id))
+        for w in workers:
+            w.await_role(timeout=10.0)
+
+        # Frame length of a full-params broadcast: depends only on leaf
+        # shapes/dtypes (+ a round digit or two of header JSON), so one
+        # sample stands for every round.
+        params_np = jax.tree.map(np.asarray, coord.server_state.params)
+        full_len = wire_frame_length(params_np, {"round": 1, "down": "full"})
+
+        coord.run_round()                 # warmup: jit compile + delta base
+        coord.round_timeout = round_timeout
+        for _ in range(rounds):
+            before = {c: reg.counter(c).value for c in _COUNTERS}
+            rec = coord.run_round()
+            delta = {c: reg.counter(c).value - before[c] for c in _COUNTERS}
+            sends = int(rec.get("completed", 0))
+            per_round.append({
+                "encodes": int(delta["comm.broadcast_encode_total"]),
+                "bytes_sent": int(delta["comm.bytes_sent"]),
+                "bytes_saved": int(delta["comm.bytes_saved_downlink"]),
+                "resyncs": int(delta["comm.resync_total"]),
+                "sends": sends,
+                "round_time_s": rec["round_time_s"],
+                "fold_overlap_s": rec.get("phase_fold_overlap_s", 0.0),
+            })
+    finally:
+        for w in workers:
+            w.stop()
+        broker.stop()
+        if coord is not None:
+            coord.close()
+
+    encodes = [r["encodes"] for r in per_round]
+    saved_per_send = (
+        per_round[-1]["bytes_saved"] / max(1, per_round[-1]["sends"])
+        if scheme != "none" else 0.0
+    )
+    downlink_frame = full_len - saved_per_send
+    return {
+        "bench": "wire_round",
+        "model": "cnn-w16",
+        "dataset": "mnist_tiny",
+        "cohort": n_workers,
+        "scheme": scheme,
+        "rounds": rounds,
+        # Serialize-once: one broadcast encode per round, cohort-independent.
+        "encodes_per_round": max(encodes),
+        # The replaced path encoded the full model once PER REQUEST.
+        "encodes_per_round_before": n_workers,
+        "full_frame_bytes": int(full_len),
+        "downlink_frame_bytes": int(downlink_frame),
+        "downlink_reduction_x": round(full_len / downlink_frame, 2),
+        "bytes_sent_per_round": int(statistics.mean(
+            r["bytes_sent"] for r in per_round)),
+        "bytes_saved_per_round": int(statistics.mean(
+            r["bytes_saved"] for r in per_round)),
+        "resyncs_total": sum(r["resyncs"] for r in per_round),
+        "round_time_s_mean": round(statistics.mean(
+            r["round_time_s"] for r in per_round), 4),
+        "fold_overlap_s_mean": round(statistics.mean(
+            r["fold_overlap_s"] for r in per_round), 4),
+        "per_round": per_round,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="measured rounds per configuration (after 1 warmup)")
+    ap.add_argument("--cohorts", default="2,4",
+                    help="comma-separated cohort sizes")
+    ap.add_argument("--schemes", default="none,int8",
+                    help="comma-separated compress_down schemes")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "results", "wire_bench.jsonl"))
+    ap.add_argument("--warmup-timeout", type=float, default=300.0)
+    ap.add_argument("--round-timeout", type=float, default=60.0)
+    args = ap.parse_args(argv)
+
+    rows = []
+    for n in (int(c) for c in args.cohorts.split(",") if c):
+        for scheme in (s.strip() for s in args.schemes.split(",") if s):
+            t0 = time.time()
+            row = run_bench(n, scheme, args.rounds,
+                            args.warmup_timeout, args.round_timeout)
+            row["bench_wall_s"] = round(time.time() - t0, 1)
+            rows.append(row)
+            print(json.dumps({k: v for k, v in row.items()
+                              if k != "per_round"}))
+            if row["encodes_per_round"] != 1:
+                print(f"FAIL: {row['encodes_per_round']} broadcast encodes "
+                      f"per round at cohort {n} (want exactly 1)",
+                      file=sys.stderr)
+                return 1
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"wrote {len(rows)} rows to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

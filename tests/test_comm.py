@@ -717,3 +717,138 @@ def test_quorum_round_is_noop():
         finally:
             for w in workers:
                 w.stop()
+
+
+# ------------------------------------------------------- wire fast path ----
+def _run_federation(cfg, n, rounds):
+    """Run a fresh broker + n workers + coordinator for ``rounds`` rounds;
+    returns (records, final per-round train losses, coordinator params)."""
+    import jax
+
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(n)
+        ]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=n, timeout=20.0)
+            coord.trainers.sort(key=lambda d: int(d.device_id))
+            for w in workers:
+                w.await_role(timeout=10.0)
+            recs = [coord.run_round() for _ in range(rounds)]
+            params = jax.tree.map(np.asarray, coord.server_state.params)
+            coord.close()
+            return recs, [r["train_loss"] for r in recs], params
+        finally:
+            for w in workers:
+                w.stop()
+
+
+@pytest.mark.parametrize("cohort", [2, 4])
+def test_broadcast_serializes_once_per_round(cohort):
+    """Serialize-once: comm.broadcast_encode_total advances by exactly ONE
+    per round regardless of cohort size (the replaced path encoded per
+    request — ``cohort`` times)."""
+    from colearn_federated_learning_tpu import telemetry
+
+    cfg = _config(num_clients=cohort)
+    ctr = telemetry.get_registry().counter("comm.broadcast_encode_total")
+    before = ctr.value
+    recs, _, _ = _run_federation(cfg, cohort, rounds=3)
+    assert all(r["completed"] == cohort for r in recs)
+    assert ctr.value - before == 3
+
+
+def test_downlink_int8_tracks_full_params_baseline():
+    """A compress_down=int8 federation must land within tolerance of the
+    uncompressed run (reconstruction-base error feedback bounds the
+    drift), save downlink bytes every post-base round, and never resync
+    in a fault-free run."""
+    import dataclasses
+
+    from colearn_federated_learning_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    cfg = _config(num_clients=3, momentum=0.0, lr=0.05)
+    base_recs, base_losses, base_params = _run_federation(cfg, 3, rounds=4)
+
+    cfg_dn = cfg.replace(fed=dataclasses.replace(cfg.fed,
+                                                 compress_down="int8"))
+    saved = reg.counter("comm.bytes_saved_downlink")
+    resync = reg.counter("comm.resync_total")
+    saved0, resync0 = saved.value, resync.value
+    dn_recs, dn_losses, dn_params = _run_federation(cfg_dn, 3, rounds=4)
+
+    assert all(r["completed"] == 3 for r in base_recs + dn_recs)
+    # Round 0 ships the full base; rounds 1-3 each save bytes on all 3
+    # sends of the quantized delta frame.
+    assert saved.value - saved0 > 0
+    assert resync.value - resync0 == 0
+    # int8 quantization perturbs each round slightly; the trajectories
+    # must stay close, not bitwise equal.
+    np.testing.assert_allclose(dn_losses, base_losses, rtol=0.15, atol=0.05)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(base_params), jax.tree.leaves(dn_params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=0.25, atol=0.02)
+
+
+def test_streaming_folder_is_arrival_order_invariant():
+    """StreamingFolder staged adds in ANY arrival order finalize to the
+    bitwise-identical sums of an UpdateFolder fed in cohort order."""
+    import itertools
+
+    import jax
+
+    from colearn_federated_learning_tpu.comm.aggregation import (
+        StreamingFolder,
+        UpdateFolder,
+    )
+
+    rng = np.random.default_rng(0)
+    shapes = {"w": np.zeros((5, 3), np.float32), "b": np.zeros((3,),
+                                                              np.float32)}
+    updates = [
+        ({"client_id": str(i), "weight": 1.0 + 0.5 * i,
+          "mean_loss": 0.3 * i},
+         {"w": rng.normal(size=(5, 3)).astype(np.float32),
+          "b": rng.normal(size=(3,)).astype(np.float32)})
+        for i in range(3)
+    ]
+    order = [m["client_id"] for m, _ in updates]
+
+    ref = UpdateFolder(shapes)
+    for meta, delta in updates:
+        ref.add(meta, delta)
+    ref_mean, ref_w, ref_loss = ref.mean()
+
+    for perm in itertools.permutations(updates):
+        sf = StreamingFolder(shapes, order=order)
+        for meta, delta in perm:
+            sf.add(meta, delta)
+        sf.finalize()
+        assert sf.folded_ids == order
+        mean, w, loss = sf.mean()
+        assert w == ref_w and loss == ref_loss
+        for a, b in zip(jax.tree_util.tree_leaves(mean),
+                        jax.tree_util.tree_leaves(ref_mean)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_streaming_folder_rejects_add_after_finalize():
+    from colearn_federated_learning_tpu.comm.aggregation import (
+        StreamingFolder,
+    )
+
+    sf = StreamingFolder({"w": np.zeros((2,), np.float32)})
+    sf.add({"client_id": "0", "weight": 1.0},
+           {"w": np.ones((2,), np.float32)})
+    sf.finalize()
+    sf.finalize()                       # idempotent
+    with pytest.raises(RuntimeError):
+        sf.add({"client_id": "1"}, {"w": np.ones((2,), np.float32)})

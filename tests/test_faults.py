@@ -276,3 +276,52 @@ def test_broker_client_alive_flips_on_broker_death():
         __import__("time").sleep(0.05)
     assert not cli.alive()
     cli.close()
+
+
+# ------------------------------------------------- downlink delta resync ----
+def test_downlink_int8_resyncs_and_converges_under_faults():
+    """Downlink delta compression under a flap/drop/crash plan: the
+    dropped round opens a round gap in that worker's param cache, so the
+    next delta broadcast MUST trigger a full-params resync
+    (``comm.resync_total``), and the faulted int8 run must land within
+    tolerance of the same-faults full-params baseline (the resync ships
+    the coordinator's reconstruction, so rejoiners match their peers)."""
+    import dataclasses
+
+    from colearn_federated_learning_tpu.faults.soak import (
+        default_soak_config,
+        run_soak,
+    )
+
+    def plan():
+        # Rebuilt per run: FaultPlan.fired mutates.
+        return FaultPlan([
+            FaultSpec(kind="flap_reconnect", device_id="1", round=1,
+                      op="train", count=2),
+            FaultSpec(kind="drop_request", device_id="2", round=2,
+                      op="train"),
+            FaultSpec(kind="crash_worker", device_id="3", round=4,
+                      op="train"),
+        ], seed=11)
+
+    base = run_soak(rounds=7, n_workers=4, plan=plan(),
+                    round_timeout=8.0)
+
+    cfg = default_soak_config(4)
+    cfg = dataclasses.replace(
+        cfg, fed=dataclasses.replace(cfg.fed, compress_down="int8"))
+    resync0 = _counter("comm.resync_total")
+    saved0 = _counter("comm.bytes_saved_downlink")
+    dn = run_soak(rounds=7, n_workers=4, plan=plan(),
+                  round_timeout=8.0, config=cfg)
+
+    # Device 2 missed round 2 entirely, so round 3's delta (base=2) found
+    # a stale cache and went through the full-params resync path.
+    assert _counter("comm.resync_total") - resync0 >= 1
+    assert _counter("comm.bytes_saved_downlink") - saved0 > 0
+    # Same fault trajectory in both runs...
+    assert dn["skipped_rounds"] == base["skipped_rounds"]
+    assert dn["evicted"] == base["evicted"]
+    # ...and the quantized run converges next to the full-params one.
+    assert base["weighted_acc"] is not None
+    assert abs(dn["weighted_acc"] - base["weighted_acc"]) <= 0.1

@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL006), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL007), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -276,6 +276,52 @@ def test_cl006_allows_host_sync_outside_hot_paths(tmp_path):
             return float(x)
     """, relpath="pkg/fed/mod.py")
     assert res.findings == []
+
+
+# ------------------------------------------------------------- CL007 ----
+def test_cl007_flags_per_request_encode_in_hot_fanout_loop(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.utils.serialization import pytree_to_bytes
+
+        def broadcast(devs, params):
+            for d in devs:  # colearn: hot
+                d.send(pytree_to_bytes(params))
+    """)
+    assert rule_ids(res) == ["CL007"]
+
+
+def test_cl007_allows_encode_hoisted_before_the_loop(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.utils.serialization import pytree_to_bytes
+
+        def broadcast(devs, params):
+            body = pytree_to_bytes(params)
+            for d in devs:  # colearn: hot
+                d.send(body)
+    """)
+    assert res.findings == []
+
+
+def test_cl007_ignores_loops_not_marked_hot(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.utils.serialization import pytree_to_bytes
+
+        def snapshot_all(trees):
+            for t in trees:
+                yield pytree_to_bytes(t)
+    """)
+    assert res.findings == []
+
+
+def test_cl007_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.utils.serialization import save_pytree_npz
+
+        def dump(devs, params):
+            for d in devs:  # colearn: hot
+                save_pytree_npz(d.path, params)  # colearn: noqa(CL007)
+    """)
+    assert res.findings == [] and res.suppressed == 1
 
 
 # ------------------------------------------- engine machinery ----------

@@ -1,0 +1,101 @@
+"""utils/serialization.py: bfloat16 survives both formats, and
+``wire_frame_length`` predicts the exact CLW1 frame size.
+
+The bf16 pitfall: ml_dtypes extension dtypes stringify as raw void bytes
+(``'<V2'``), so a dtype-``str`` round trip silently reinterprets the
+payload.  Both the CLW1 ``"n"`` slot and the npz ``__dtypes__`` sidecar
+exist to carry the dtype NAME instead — these tests pin that contract.
+"""
+
+import io
+import json
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu.utils import serialization
+
+
+def _bf16_tree():
+    bf16 = jnp.bfloat16
+    return {
+        "w": np.arange(12, dtype=np.float32).reshape(3, 4).astype(bf16),
+        "b": np.array([-1.5, 0.25, 3.0], dtype=bf16),
+        "scale": np.array(0.125, dtype=bf16),          # 0-d leaf
+        "step": np.array(7, dtype=np.int32),
+        "f32": np.linspace(-1, 1, 5, dtype=np.float32),
+    }
+
+
+def _assert_bitwise(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype
+    np.testing.assert_array_equal(
+        np.ascontiguousarray(a).reshape(-1).view(np.uint8),
+        np.ascontiguousarray(b).reshape(-1).view(np.uint8))
+
+
+def test_wire_roundtrip_preserves_bf16():
+    tree = _bf16_tree()
+    out, meta = serialization.bytes_to_pytree(
+        serialization.pytree_to_bytes(tree, {"round": 3}))
+    assert meta == {"round": 3}
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["w"].shape == (3, 4)
+    _assert_bitwise(out["w"], tree["w"])
+    _assert_bitwise(out["b"], tree["b"])
+    _assert_bitwise(out["step"], tree["step"])
+    _assert_bitwise(out["f32"], tree["f32"])
+    # Known wire-layout quirk: CLW1 promotes 0-d leaves to (1,) (the
+    # encoder's ascontiguousarray).  Value and dtype still round-trip.
+    assert out["scale"].shape == (1,)
+    assert out["scale"].dtype == jnp.bfloat16
+    assert float(out["scale"][0]) == 0.125
+
+
+def test_wire_header_names_extension_dtypes_only():
+    data = serialization.pytree_to_bytes(_bf16_tree())
+    (hlen,) = struct.unpack_from(">I", data, 4)
+    header = json.loads(bytes(data[8:8 + hlen]).decode())
+    by_path = {e["p"]: e for e in header["leaves"]}
+    # bf16 leaves carry the dtype-name slot; builtin dtypes must not
+    # (the slot exists only because '<V2' is ambiguous).
+    assert by_path["w"]["n"] == "bfloat16" and by_path["w"]["d"] == "<V2"
+    assert "n" not in by_path["step"]
+    assert "n" not in by_path["f32"]
+
+
+def test_npz_roundtrip_preserves_bf16_and_0d_shape():
+    tree = _bf16_tree()
+    buf = io.BytesIO()
+    serialization.save_pytree_npz(buf, tree, {"tag": "ckpt"})
+    buf.seek(0)
+    out, meta = serialization.load_pytree_npz(buf)
+    assert meta == {"tag": "ckpt"}
+    _assert_bitwise(out["w"], tree["w"])
+    _assert_bitwise(out["b"], tree["b"])
+    # The npz sidecar records the true shape, so 0-d survives exactly.
+    assert out["scale"].shape == ()
+    assert out["scale"].dtype == jnp.bfloat16
+    assert float(out["scale"]) == 0.125
+
+
+def test_bytes_to_pytree_autodetects_npz_with_bf16():
+    buf = io.BytesIO()
+    serialization.save_pytree_npz(buf, _bf16_tree())
+    out, _ = serialization.bytes_to_pytree(buf.getvalue())
+    _assert_bitwise(out["w"], _bf16_tree()["w"])
+
+
+def test_wire_frame_length_matches_encoder():
+    for tree, meta in [
+        (_bf16_tree(), None),
+        (_bf16_tree(), {"round": 12, "down": "full"}),
+        ({"a": np.zeros((8, 8), np.float32)}, {"round": 0}),
+        ({"empty": np.zeros((0,), np.float32),
+          "zero_d": np.float64(2.5)}, None),
+    ]:
+        predicted = serialization.wire_frame_length(tree, meta)
+        actual = len(serialization.pytree_to_bytes(tree, meta))
+        assert predicted == actual
