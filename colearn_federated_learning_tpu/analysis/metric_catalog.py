@@ -20,9 +20,12 @@ from __future__ import annotations
 # ---------------------------------------------------------------- catalog --
 # Counters -----------------------------------------------------------------
 COUNTERS = (
-    # checkpoint plane (ckpt/manager.py)
+    # checkpoint plane (ckpt/manager.py, ckpt/wal.py)
     "ckpt.saves_total",
     "ckpt.restores_total",
+    "ckpt.wal_appends_total",
+    "ckpt.wal_torn_tail_total",            # in-flight append lost to a kill
+    "ckpt.wal_uncommitted_discarded_total",  # logged rounds past the ckpt
     # engine plane (fed/engine.py, fed/local.py)
     "engine.rounds_total",
     "local.trainers_built",
@@ -48,6 +51,10 @@ COUNTERS = (
     "fed.clients_dropped",
     "fed.clients_evicted",
     "fed.rounds_skipped_quorum",
+    "fed.rounds_resumed_total",      # --resume restored a checkpoint
+    # file & hierarchical planes (fed/offline.py, fed/hierarchical.py)
+    "fed.offline_updates_rejected_total",  # labeled {reason=torn|stale|...}
+    "fed.hier_groups_dropped_total",       # labeled per group: {group=g1}
     # buffered-async plane (comm/async_coordinator.py)
     "async.dispatch_failures",
     "async.aggregations_total",

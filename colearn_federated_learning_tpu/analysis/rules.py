@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL007).
+"""The colearn rule set (CL001–CL008).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -369,3 +369,72 @@ class SerializeInFanOutLoop(Rule):
                         f"{tail}() inside a `# colearn: hot` fan-out loop "
                         "re-encodes the full model per request; encode "
                         "once and pass request(body=...)")
+
+
+# ----------------------------------------------------------------- CL008 --
+@register
+class NonAtomicExchangeWrite(Rule):
+    """The file-exchange plane (fed/) hands artifacts to OTHER processes
+    by path: a reader (or a SIGKILL mid-write) that lands between open
+    and close sees a torn file.  Every exchange write must go through a
+    temp file + ``os.replace`` so readers only ever observe complete
+    artifacts (utils.serialization.atomic_save_pytree_npz)."""
+
+    id = "CL008"
+    title = "non-atomic write on a file-exchange path"
+    hint = ("write via utils.serialization.atomic_save_pytree_npz (or "
+            "temp file + os.replace in the same function); mark a "
+            "single-process scratch write with `# colearn: noqa(CL008)`")
+
+    # Explicit dotted forms for the numpy writers so a method named
+    # `.save()` on some manager object (orbax is atomic internally)
+    # doesn't trip the rule; save_pytree_npz is unambiguous at any depth.
+    _NP_WRITERS = {"np.savez", "numpy.savez", "np.savez_compressed",
+                   "numpy.savez_compressed", "np.save", "numpy.save"}
+
+    def _is_writer(self, call: ast.Call) -> Optional[str]:
+        dotted = dotted_name(call.func)
+        if dotted in self._NP_WRITERS:
+            return dotted
+        if dotted.rsplit(".", 1)[-1] == "save_pytree_npz":
+            return "save_pytree_npz"
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            mode = None
+            if len(call.args) >= 2:
+                mode = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and "w" in mode.value):
+                return f"open(..., {mode.value!r})"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("fed"):
+            return
+        enclosing = _enclosing_functions(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            writer = self._is_writer(node)
+            if writer is None:
+                continue
+            fns = enclosing.get(id(node), ())
+            atomic = False
+            for fn in fns:
+                for inner in ast.walk(fn):
+                    if (isinstance(inner, ast.Call)
+                            and dotted_name(inner.func) == "os.replace"):
+                        atomic = True
+                        break
+                if atomic:
+                    break
+            if atomic:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{writer} writes an exchange file in place: a reader or "
+                "kill mid-write sees a torn artifact; use temp file + "
+                "os.replace")

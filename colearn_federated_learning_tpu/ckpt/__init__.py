@@ -1,3 +1,4 @@
 from colearn_federated_learning_tpu.ckpt.manager import RoundCheckpointer
+from colearn_federated_learning_tpu.ckpt.wal import RoundWal
 
-__all__ = ["RoundCheckpointer"]
+__all__ = ["RoundCheckpointer", "RoundWal"]

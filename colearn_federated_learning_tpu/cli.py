@@ -378,6 +378,26 @@ def _write_coordinator_trace(config, coord) -> None:
     print(f"trace written to {path}", file=sys.stderr)
 
 
+def _coordinator_resume(coord) -> None:
+    """Tolerant ``--resume``: restore the latest checkpoint if one exists,
+    else start cold (a coordinator killed before its FIRST checkpoint has
+    nothing to restore — that must not crash the recovery supervisor).
+    Emits a machine-readable event line either way; the mp chaos harness
+    (faults/procsoak.py) keys its resume ledger on it."""
+    from colearn_federated_learning_tpu import telemetry
+
+    try:
+        step = coord.restore_checkpoint()
+    except FileNotFoundError:
+        print(json.dumps({"event": "resume_cold"}), file=sys.stderr)
+        return
+    print(json.dumps({
+        "event": "resumed", "round": step,
+        "rounds_resumed_total": telemetry.get_registry().counter(
+            "fed.rounds_resumed_total").value,
+    }), file=sys.stderr)
+
+
 def cmd_coordinate(args: argparse.Namespace) -> int:
     from colearn_federated_learning_tpu.comm.coordinator import (
         FederatedCoordinator,
@@ -442,8 +462,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
         )
         with coord:
             if args.resume:
-                step = coord.restore_checkpoint()
-                print(f"resumed at model version {step}", file=sys.stderr)
+                _coordinator_resume(coord)
             coord.enroll(min_devices=args.min_devices,
                          timeout=args.enroll_timeout)
             remaining = max(0, config.fed.rounds - len(coord.history))
@@ -461,8 +480,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
                                  mud_policy=mud_policy)
     with coord:
         if args.resume:
-            step = coord.restore_checkpoint()
-            print(f"resumed at round {step}", file=sys.stderr)
+            _coordinator_resume(coord)
         coord.enroll(min_devices=args.min_devices,
                      timeout=args.enroll_timeout)
         hist = coord.fit(log_fn=lambda rec: print(json.dumps(rec),
@@ -476,8 +494,33 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
 
 
 def cmd_chaos(args: argparse.Namespace) -> int:
-    """In-process chaos soak: broker + workers + coordinator in this
-    process, a fault plan installed after the warmup round (faults/soak)."""
+    """Chaos soak.  Default: broker + workers + coordinator in THIS
+    process, a fault plan installed after the warmup round (faults/soak).
+    ``--mp``: broker, coordinator and workers as real subprocesses on
+    real ports, SIGKILLed on a seeded schedule — including the
+    coordinator, which must come back with --resume (faults/procsoak)."""
+    if args.mp:
+        from colearn_federated_learning_tpu.faults import procsoak
+
+        kills = ([] if args.no_faults
+                 else procsoak.canned_kill_schedule(args.rounds,
+                                                    args.num_workers))
+        summary = procsoak.run_proc_soak(
+            rounds=args.rounds, n_workers=args.num_workers, kills=kills,
+            workdir=args.workdir, round_timeout=args.mp_round_timeout,
+            timeout_s=args.mp_timeout,
+            log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+        )
+        for k in summary["kills"]:
+            print(f"# killed {k['target']} after round "
+                  f"{k['fired_after_round']}", file=sys.stderr)
+        print(json.dumps(summary))
+        need_resume = any(k.target == "coordinator" for k in kills)
+        ok = (summary["exit_code"] == 0
+              and summary["rounds_run"] == args.rounds
+              and summary["weighted_acc"] is not None
+              and (summary["rounds_resumed"] >= 1 or not need_resume))
+        return 0 if ok else 1
     import jax
 
     try:
@@ -507,6 +550,8 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         round_timeout=args.round_timeout, config=config,
         log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
     )
+    for t in summary.get("top_faults", [])[:5]:
+        print(f"# top fault {t['label']}: {t['count']}", file=sys.stderr)
     print(json.dumps(summary))
     ok = (summary["rounds_run"] == args.rounds
           and summary["weighted_acc"] is not None)
@@ -716,11 +761,25 @@ def main(argv: list[str] | None = None) -> int:
                          help="soak with downlink delta compression on "
                               "(exercises the cache-miss resync path "
                               "under faults)")
+    p_chaos.add_argument("--mp", action="store_true",
+                         help="multi-process soak: broker/coordinator/"
+                              "workers as real subprocesses, real SIGKILL "
+                              "on the canned schedule (coordinator "
+                              "included — exercises --resume recovery)")
+    p_chaos.add_argument("--workdir", default=None,
+                         help="--mp scratch dir for checkpoints + process "
+                              "logs (default: a fresh temp dir)")
+    p_chaos.add_argument("--mp-round-timeout", type=float, default=120.0,
+                         help="--mp per-round deadline (covers the first "
+                              "round's jit compile in every worker)")
+    p_chaos.add_argument("--mp-timeout", type=float, default=600.0,
+                         help="--mp whole-soak wall-clock backstop; a hung "
+                              "federation is killed and reported")
     p_chaos.set_defaults(fn=cmd_chaos)
 
     p_lint = sub.add_parser("lint",
                             help="run the AST invariant checks "
-                                 "(CL001-CL007; analysis/) — fast, "
+                                 "(CL001-CL008; analysis/) — fast, "
                                  "CPU-only, no jax init")
     p_lint.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the installed "

@@ -484,6 +484,7 @@ class AsyncFederatedCoordinator:
                 if "dp_z_eff" in rec:
                     self.accountant.step(1, sampling_rate=1.0,
                                          noise_multiplier=rec["dp_z_eff"])
+        telemetry.get_registry().counter("fed.rounds_resumed_total").inc()
         return step
 
     def fit(self, aggregations: int, log_fn=None,

@@ -124,6 +124,12 @@ class FederatedCoordinator:
         # compression (fed.compress_down; "none" keeps the wire identical).
         self._downlink = DownlinkEncoder(config.fed.compress_down)
         self._ckpt = None
+        # Round WAL rides next to the orbax checkpoint: one fsynced JSON
+        # line per round (counter + accepted-update manifest), the durable
+        # half of crash recovery the heavyweight state save can't cover
+        # between cadence points.
+        self._wal = None
+        self._last_accepted: list[int] = []
         # RDP accounting mirrors the engine's; each round is charged with
         # the ACTUAL cohort fraction and REALIZED noise (membership is
         # elastic here and stragglers drop mid-round).
@@ -156,6 +162,9 @@ class FederatedCoordinator:
         if self._ckpt is not None:
             self._ckpt.close()
             self._ckpt = None
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
 
     def __enter__(self):
         return self
@@ -403,6 +412,10 @@ class FederatedCoordinator:
                                       key=lambda c: pos.get(c, len(pos))))
             received = [int(c) for c in folder.folded_ids]
             folded = folder.count
+            # Accepted-update manifest for the round WAL (crash recovery);
+            # deliberately NOT part of the round record, whose byte layout
+            # is contract-tested.
+            self._last_accepted = received
 
             # Aggregation quorum: a sub-quorum round is an explicit no-op
             # (the secure-agg discarded-round convention) rather than a
@@ -616,6 +629,15 @@ class FederatedCoordinator:
             self._ckpt = RoundCheckpointer.for_run(self.config.run)
         return self._ckpt
 
+    def _round_wal(self):
+        if self._wal is None:
+            from colearn_federated_learning_tpu.ckpt import RoundWal
+
+            if not self.config.run.checkpoint_dir:
+                raise ValueError("config.run.checkpoint_dir is not set")
+            self._wal = RoundWal(self.config.run.checkpoint_dir)
+        return self._wal
+
     def _acct_rdp(self) -> np.ndarray:
         # orbax refuses zero-size arrays, so "no accountant" is a (1,) zero.
         return (self.accountant.total_rdp if self.accountant is not None
@@ -635,15 +657,29 @@ class FederatedCoordinator:
         A killed ``colearn coordinate`` run picks up exactly where it
         stopped — workers are stateless between rounds (they receive the
         global params every round), so only the coordinator's server state,
-        history and privacy budget need to survive."""
-        state, history, step = self._checkpointer().restore(
-            (self.server_state, self._acct_rdp())
-        )
-        self.server_state, acct_rdp = state
-        self.history = history
-        if self.accountant is not None:
-            self.accountant.total_rdp = np.asarray(acct_rdp)
-            self.accountant._steps = step
+        history and privacy budget need to survive.
+
+        WAL reconciliation: rounds logged past the restored checkpoint
+        step ran but never committed their server-state delta (the crash
+        landed between WAL append and state save) — they are discarded
+        (``ckpt.wal_uncommitted_discarded_total``) and re-run."""
+        reg = telemetry.get_registry()
+        with self.tracer.span("resume"):
+            state, history, step = self._checkpointer().restore(
+                (self.server_state, self._acct_rdp())
+            )
+            self.server_state, acct_rdp = state
+            self.history = history
+            if self.accountant is not None:
+                self.accountant.total_rdp = np.asarray(acct_rdp)
+                self.accountant._steps = step
+            wal = self._round_wal()
+            logged = wal.load()
+            if len(logged) > step:
+                reg.counter("ckpt.wal_uncommitted_discarded_total").inc(
+                    len(logged) - step)
+                wal.rewind(step)
+        reg.counter("fed.rounds_resumed_total").inc()
         return step
 
     def fit(self, rounds: Optional[int] = None, log_fn=None,
@@ -664,18 +700,31 @@ class FederatedCoordinator:
             if elastic:
                 self.refresh_membership()
             rec = self.run_round()
+            if want_ckpt:
+                # WAL first, state second: an entry past the latest
+                # checkpoint step marks an uncommitted round for resume.
+                self._round_wal().append({
+                    "round": rec["round"],
+                    "accepted": list(self._last_accepted),
+                    "completed": rec["completed"],
+                    "total_weight": rec["total_weight"],
+                })
             if self.evaluator is not None and (
                 rec["round"] % max(1, eval_every) == 0
                 or rec["round"] == last_round
             ):
                 rec.update(self.evaluate())
-            if log_fn is not None:
-                log_fn(rec)
-            # Like the engine: with a checkpoint_dir the final round always
-            # checkpoints, so --resume works without a periodic cadence.
+            # Checkpoint BEFORE the record is logged: a logged round is a
+            # durably committed round (at the configured cadence), so a
+            # kill keyed on the record line — the mp chaos harness — lands
+            # on a checkpoint that exists.  With a checkpoint_dir the
+            # final round always checkpoints, so --resume works without a
+            # periodic cadence.
             if want_ckpt and (
                 (ckpt_every and (rec["round"] + 1) % ckpt_every == 0)
                 or rec["round"] == last_round
             ):
                 self.save_checkpoint()
+            if log_fn is not None:
+                log_fn(rec)
         return self.history

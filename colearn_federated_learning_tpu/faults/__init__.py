@@ -1,22 +1,27 @@
-"""Deterministic fault injection for the federation comm plane.
+"""Deterministic fault injection for the federation planes.
 
 ``plan`` describes WHAT fails (a seeded schedule keyed by
-``(device_id, round, op)``), ``inject`` applies it at the transport
-interposer seams, and ``soak`` runs an in-process federation under a plan
-and reports whether the robustness machinery (retries, quorum, eviction,
-CRC framing) actually held.  Production code never imports this package —
-comm/transport.py only exposes the seams.
+``(device_id, round, op[, hop])``), ``inject`` applies it at the
+transport interposer seams, ``fileplane`` applies the file/hierarchical
+kinds at the exchange-file seams, and ``soak``/``procsoak`` run a
+federation under a plan — in-process and as real subprocesses with real
+SIGKILL respectively — and report whether the robustness machinery
+(retries, quorum, eviction, CRC framing, checkpoint resume) actually
+held.  Production code never imports this package beyond the hook
+functions — comm/transport.py only exposes the seams.
 """
 
 from colearn_federated_learning_tpu.faults.plan import (
     ANY,
     ANY_ROUND,
+    FILE_KINDS,
     KINDS,
     FaultPlan,
     FaultSpec,
 )
 from colearn_federated_learning_tpu.faults.inject import (
     FaultInjector,
+    active_plan,
     install,
     uninstall,
 )
@@ -25,17 +30,27 @@ from colearn_federated_learning_tpu.faults.soak import (
     default_soak_config,
     run_soak,
 )
+from colearn_federated_learning_tpu.faults.procsoak import (
+    KillSpec,
+    canned_kill_schedule,
+    run_proc_soak,
+)
 
 __all__ = [
     "ANY",
     "ANY_ROUND",
+    "FILE_KINDS",
     "KINDS",
     "FaultPlan",
     "FaultSpec",
     "FaultInjector",
+    "KillSpec",
+    "active_plan",
+    "canned_kill_schedule",
     "install",
     "uninstall",
     "canned_plan",
     "default_soak_config",
+    "run_proc_soak",
     "run_soak",
 ]

@@ -31,15 +31,26 @@ from colearn_federated_learning_tpu.telemetry import registry as _metrics
 _REQUEST_KINDS = ("delay", "drop_request", "flap_reconnect", "crash_worker")
 _REPLY_KINDS = ("corrupt_payload",)
 
+# The installed plan, shared with the file/hierarchical plane hooks
+# (faults/fileplane.py) so one ``install`` drives every plane.
+_active_plan: FaultPlan | None = None
+
+
+def active_plan() -> FaultPlan | None:
+    """The currently installed plan, or None — the file/hierarchical
+    fault hooks are zero-cost no-ops when this is None."""
+    return _active_plan
+
 
 def _key(header: dict) -> tuple[int, str]:
     rnd = header.get("round")
     return (None if rnd is None else int(rnd)), str(header.get("op", ""))
 
 
-def _count(kind: str) -> None:
+def _count(kind: str, device: str = "") -> None:
     reg = _metrics.get_registry()
-    reg.counter("fault.injected_total").inc()
+    reg.counter("fault.injected_total",
+                labels={"device": str(device), "kind": kind}).inc()
     reg.counter(f"fault.injected.{kind}").inc()
 
 
@@ -62,7 +73,7 @@ class FaultInjector(transport.TransportInterposer):
         self.plan = plan
 
     def _apply(self, fault, server, conn) -> None:
-        _count(fault.kind)
+        _count(fault.kind, server.ident if server is not None else "")
         if fault.kind == "delay":
             time.sleep(fault.ms / 1000.0)
         elif fault.kind == "drop_request":
@@ -85,7 +96,7 @@ class FaultInjector(transport.TransportInterposer):
         rnd, op = _key(header)
         for f in self.plan.match(server.ident, rnd, op,
                                  kinds=_REPLY_KINDS, site="server"):
-            _count(f.kind)
+            _count(f.kind, server.ident)
             send_corrupt_frame(conn)
             raise protocol.ConnectionClosed(f"injected corruption ({f})")
 
@@ -94,7 +105,7 @@ class FaultInjector(transport.TransportInterposer):
         for f in self.plan.match(client.ident, rnd, op,
                                  kinds=("delay", "flap_reconnect"),
                                  site="client"):
-            _count(f.kind)
+            _count(f.kind, client.ident)
             if f.kind == "delay":
                 time.sleep(f.ms / 1000.0)
             else:
@@ -103,11 +114,17 @@ class FaultInjector(transport.TransportInterposer):
 
 def install(plan: FaultPlan) -> FaultInjector:
     """Install ``plan`` process-wide; returns the injector (its ``plan``
-    keeps the firing ledger).  Call :func:`uninstall` when done."""
+    keeps the firing ledger).  Also publishes the plan to the
+    file/hierarchical plane hooks (:func:`active_plan`).  Call
+    :func:`uninstall` when done."""
+    global _active_plan
     injector = FaultInjector(plan)
     transport.install_interposer(injector)
+    _active_plan = plan
     return injector
 
 
 def uninstall() -> None:
+    global _active_plan
     transport.install_interposer(None)
+    _active_plan = None

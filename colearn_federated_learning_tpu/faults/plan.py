@@ -1,11 +1,22 @@
-"""Deterministic fault schedules for the federation comm plane.
+"""Deterministic fault schedules for the federation planes.
 
 A :class:`FaultPlan` is a seeded list of :class:`FaultSpec` entries keyed
-by ``(device_id, round, op)``.  Matching is pure bookkeeping — the plan
-never touches a socket; :mod:`.inject` turns matches into transport
-behavior.  Determinism is the point: the same plan + seed produces the
-same faults at the same keys on every run, so a chaos soak is a
-regression test, not a dice roll.
+by ``(device_id, round, op)`` — and, for the file/hierarchical planes, by
+``hop`` (which exchange leg the fault hits).  Matching is pure
+bookkeeping — the plan never touches a socket or a file; :mod:`.inject`
+turns matches into transport behavior and :mod:`.fileplane` into
+exchange-file behavior.  Determinism is the point: the same plan + seed
+produces the same faults at the same keys on every run, so a chaos soak
+is a regression test, not a dice roll.
+
+Comm-plane kinds (applied by :mod:`.inject` at the transport seams):
+``drop_request``, ``delay``, ``corrupt_payload``, ``crash_worker``,
+``flap_reconnect``.  File/hierarchical-plane kinds (applied by
+:mod:`.fileplane`, keyed ``(silo|group, round, hop)``):
+
+- ``truncate_file`` — an update npz is cut short mid-write (killed silo);
+- ``stale_round``   — an update carries an old round stamp (silo replay);
+- ``drop_silo``     — a silo/group's contribution never arrives.
 
 JSON surface (``--fault-plan plan.json``)::
 
@@ -13,7 +24,9 @@ JSON surface (``--fault-plan plan.json``)::
         {"kind": "delay", "device_id": "1", "round": 2, "op": "train",
          "ms": 250},
         {"kind": "corrupt_payload", "device_id": "2", "round": 3},
-        {"kind": "crash_worker", "device_id": "3", "round": 4}
+        {"kind": "truncate_file", "device_id": "silo0", "round": 1,
+         "hop": "update"},
+        {"kind": "drop_silo", "device_id": "g1", "round": 2, "hop": "sync"}
     ]}
 """
 
@@ -26,7 +39,9 @@ import zlib
 from typing import Optional
 
 KINDS = ("drop_request", "delay", "corrupt_payload", "crash_worker",
-         "flap_reconnect")
+         "flap_reconnect", "truncate_file", "stale_round", "drop_silo")
+
+FILE_KINDS = ("truncate_file", "stale_round", "drop_silo")
 
 ANY = "*"          # wildcard device_id / op
 ANY_ROUND = -1     # wildcard round
@@ -41,7 +56,9 @@ class FaultSpec:
     per-key hash of the plan seed, so sub-1.0 rates are reproducible.
     ``site`` selects which transport end applies it (faults fire on the
     device's server side by default — that is where ``device_id`` is
-    authoritative)."""
+    authoritative).  ``hop`` keys file/hierarchical-plane faults to one
+    exchange leg (file plane: ``update``; hierarchical: ``sync`` edge→
+    cloud, ``seed`` cloud→edge); it is ignored by the comm plane."""
 
     kind: str
     device_id: str = ANY
@@ -51,6 +68,7 @@ class FaultSpec:
     count: int = 1                   # max firings; 0 = unlimited
     probability: float = 1.0
     site: str = "server"             # server | client
+    hop: str = ANY                   # file/hier exchange leg
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -66,13 +84,15 @@ class FaultSpec:
             raise ValueError("ms and count must be >= 0")
 
     def matches(self, device_id: str, round_idx: Optional[int],
-                op: str) -> bool:
+                op: str, hop: str = ANY) -> bool:
         if self.device_id != ANY and self.device_id != str(device_id):
             return False
         if self.round != ANY_ROUND and (round_idx is None
                                         or int(round_idx) != self.round):
             return False
         if self.op != ANY and self.op != op:
+            return False
+        if self.hop != ANY and self.hop != hop:
             return False
         return True
 
@@ -113,12 +133,14 @@ class FaultPlan:
 
     # ---------------------------------------------------------- firing --
     def match(self, device_id: str, round_idx: Optional[int], op: str,
-              kinds: tuple = KINDS, site: str = "server"
+              kinds: tuple = KINDS, site: str = "server", hop: str = ANY
               ) -> list[FaultSpec]:
-        """The specs that FIRE for this ``(device_id, round, op)`` event,
-        consuming one firing from each returned spec's ``count`` budget.
-        Deterministic: the probability gate hashes the plan seed with the
-        event key and the spec index, never a live RNG."""
+        """The specs that FIRE for this ``(device_id, round, op[, hop])``
+        event, consuming one firing from each returned spec's ``count``
+        budget.  Deterministic: the probability gate hashes the plan seed
+        with the event key and the spec index, never a live RNG.  The hop
+        joins the hash key only when given, so comm-plane schedules are
+        bit-identical to the pre-hop format."""
         out = []
         with self._lock:
             for i, f in enumerate(self.faults):
@@ -126,12 +148,13 @@ class FaultPlan:
                     continue
                 if f.count and self._fired[i] >= f.count:
                     continue
-                if not f.matches(device_id, round_idx, op):
+                if not f.matches(device_id, round_idx, op, hop):
                     continue
                 if f.probability < 1.0:
-                    u = _hash_unit(self.seed,
-                                   f"{device_id}:{round_idx}:{op}:{i}")
-                    if u >= f.probability:
+                    key = f"{device_id}:{round_idx}:{op}:{i}"
+                    if hop != ANY:
+                        key = f"{device_id}:{round_idx}:{op}:{hop}:{i}"
+                    if _hash_unit(self.seed, key) >= f.probability:
                         continue
                 self._fired[i] += 1
                 out.append(f)

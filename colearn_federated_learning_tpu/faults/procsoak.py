@@ -1,0 +1,325 @@
+"""Multi-process chaos soak: real subprocesses, real ports, real SIGKILL.
+
+The in-process soak (faults/soak.py) exercises the robustness machinery
+through a transport interposer — everything a Python exception can
+express.  This harness exercises what it cannot: fd leaks, half-written
+frames, torn files and lost process state.  It spawns the broker, N
+``colearn worker`` processes and a ``colearn coordinate`` process on real
+sockets, then delivers ``SIGKILL`` on a deterministic schedule keyed by
+round — including to the coordinator mid-round, which must come back with
+``--resume`` and finish the original round budget from its checkpoint +
+round WAL.
+
+The schedule is event-driven, not timer-driven: a :class:`KillSpec`
+fires the moment the coordinator's stderr emits the round record for
+``after_round``, so the signal lands while the NEXT round is in flight.
+That keeps the soak deterministic in ROUND time even though wall-clock
+varies run to run.
+
+``scripts/chaos_soak_mp.py`` wraps this in a baseline-vs-faulted
+convergence gate; ``colearn chaos --mp`` is the one-run flavor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import select
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Callable, Optional
+
+_CLI = "colearn_federated_learning_tpu.cli"
+
+
+@dataclasses.dataclass(frozen=True)
+class KillSpec:
+    """One scheduled SIGKILL.
+
+    ``target`` is ``"coordinator"`` or ``"worker:<client_id>"``.  The
+    signal is sent as soon as the round record for ``after_round``
+    appears, i.e. it lands mid-round ``after_round + 1``.  ``restart``
+    respawns the victim: a worker re-announces on a fresh port (and is
+    re-admitted by the elastic coordinator after eviction), the
+    coordinator comes back with ``--resume``."""
+
+    target: str
+    after_round: int
+    restart: bool = True
+
+    def __post_init__(self):
+        if self.target != "coordinator" and not (
+                self.target.startswith("worker:")
+                and self.target.split(":", 1)[1].isdigit()):
+            raise ValueError(
+                f"target must be 'coordinator' or 'worker:<id>', "
+                f"got {self.target!r}")
+        if self.after_round < 0:
+            raise ValueError(
+                f"after_round must be >= 0, got {self.after_round}")
+        if self.target == "coordinator" and not self.restart:
+            raise ValueError(
+                "killing the coordinator without restart ends the "
+                "federation; use restart=True")
+
+
+def canned_kill_schedule(rounds: int, n_workers: int) -> list[KillSpec]:
+    """The acceptance schedule, scaled to the run length:
+
+    - a worker dies mid-round 2 and restarts (exercises eviction +
+      elastic re-admission on a fresh port) — only when the run is long
+      enough for it to be evicted AND re-converge;
+    - the coordinator dies mid-round ``rounds // 2 + 1``, after the
+      round-``rounds//2`` checkpoint committed, and must resume.
+    """
+    kills = []
+    if rounds >= 5 and n_workers >= 3:
+        kills.append(KillSpec("worker:1", after_round=1))
+    kills.append(KillSpec("coordinator",
+                          after_round=max(0, rounds // 2 - 1)))
+    return kills
+
+
+def _config_flags(rounds: int, n_workers: int, seed: int,
+                  checkpoint_dir: Optional[str] = None) -> list[str]:
+    """CLI overrides reproducing faults/soak.default_soak_config — same
+    tiny CPU federation, robustness features ON."""
+    flags = [
+        "--config", "mnist_mlp_fedavg", "--backend", "cpu",
+        "--dataset", "mnist_tiny", "--partition", "iid",
+        "--num-clients", str(n_workers), "--rounds", str(rounds),
+        "--cohort-size", "0", "--local-steps", "4", "--batch-size", "16",
+        "--lr", "0.05", "--momentum", "0.0", "--strategy", "fedavg",
+        "--min-cohort-fraction", "0.5", "--evict-after", "2",
+        "--comm-retries", "2", "--seed", str(seed),
+    ]
+    if checkpoint_dir:
+        flags += ["--checkpoint-dir", checkpoint_dir,
+                  "--checkpoint-every", "1"]
+    return flags
+
+
+def _parse_json(line: str) -> Optional[dict]:
+    try:
+        doc = json.loads(line)
+    except json.JSONDecodeError:
+        return None            # ordinary log chatter on the same stream
+    return doc if isinstance(doc, dict) else None
+
+
+class _Fleet:
+    """Process bookkeeping for one soak run (spawn/kill/cleanup)."""
+
+    def __init__(self, workdir: str, env: dict):
+        self.workdir = workdir
+        self.env = env
+        self.broker: Optional[subprocess.Popen] = None
+        self.workers: dict[int, subprocess.Popen] = {}
+        self.coord: Optional[subprocess.Popen] = None
+        self._logs: list = []
+
+    def _log_file(self, name: str):
+        f = open(os.path.join(self.workdir, name), "ab")
+        self._logs.append(f)
+        return f
+
+    def spawn(self, args: list[str], **kw) -> subprocess.Popen:
+        return subprocess.Popen([sys.executable, "-m", _CLI, *args],
+                                env=self.env, **kw)
+
+    def start_broker(self, timeout: float) -> tuple[str, int]:
+        self.broker = self.spawn(
+            ["broker"], stdout=subprocess.PIPE,
+            stderr=self._log_file("broker.log"), text=True)
+        ready, _, _ = select.select([self.broker.stdout], [], [], timeout)
+        if not ready:
+            raise RuntimeError("broker never announced its port")
+        doc = _parse_json(self.broker.stdout.readline())
+        if not doc:
+            raise RuntimeError("broker printed no address line")
+        return doc["host"], int(doc["port"])
+
+    def start_worker(self, client_id: int, cfg: list[str], host: str,
+                     port: int) -> None:
+        log = self._log_file(f"worker{client_id}.log")
+        self.workers[client_id] = self.spawn(
+            ["worker", *cfg, "--client-id", str(client_id),
+             "--broker-host", host, "--broker-port", str(port)],
+            stdout=log, stderr=log)
+
+    def start_coordinator(self, cfg: list[str], host: str, port: int,
+                          n_workers: int, round_timeout: float,
+                          enroll_timeout: float,
+                          resume: bool) -> subprocess.Popen:
+        args = ["coordinate", *cfg, "--broker-host", host,
+                "--broker-port", str(port),
+                "--min-devices", str(n_workers),
+                "--round-timeout", str(round_timeout),
+                "--enroll-timeout", str(enroll_timeout),
+                "--no-evaluator", "--per-client-eval", "--elastic"]
+        if resume:
+            args.append("--resume")
+        self.coord = self.spawn(
+            args, stdout=self._log_file("coordinator.out"),
+            stderr=subprocess.PIPE, text=True)
+        return self.coord
+
+    def kill_all(self) -> None:
+        for p in ([self.coord, self.broker] + list(self.workers.values())):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+    def close(self) -> None:
+        self.kill_all()
+        for p in ([self.coord, self.broker] + list(self.workers.values())):
+            if p is not None:
+                p.wait()
+        for f in self._logs:
+            f.close()
+
+
+def run_proc_soak(
+    rounds: int = 6,
+    n_workers: int = 3,
+    kills: Optional[list[KillSpec]] = None,
+    workdir: Optional[str] = None,
+    round_timeout: float = 120.0,
+    enroll_timeout: float = 90.0,
+    timeout_s: float = 600.0,
+    seed: int = 0,
+    log_fn: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """Run one multi-process soak and return its summary.
+
+    The summary mirrors faults/soak.run_soak where the concepts overlap
+    (``records`` — deduplicated by round, LAST record wins so a resumed
+    re-run of an uncommitted round replaces the lost one — plus
+    ``skipped_rounds``, ``evicted``, ``per_client_acc``) and adds the
+    process-level ledger: ``kills`` delivered, ``rounds_resumed`` (count
+    of successful ``--resume`` recoveries, reported by the coordinator's
+    resume event line), ``coordinator_incarnations`` and the final
+    ``exit_code``."""
+    if rounds < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    kills = list(kills or [])
+    for k in kills:
+        if k.target.startswith("worker:"):
+            wid = int(k.target.split(":", 1)[1])
+            if not 0 <= wid < n_workers:
+                raise ValueError(f"{k.target} out of range "
+                                 f"[0, {n_workers})")
+    workdir = workdir or tempfile.mkdtemp(prefix="colearn_mpsoak_")
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    env = dict(os.environ)
+    env["PYTHONUNBUFFERED"] = "1"      # round records must stream, not batch
+    env["JAX_PLATFORMS"] = "cpu"
+
+    fleet = _Fleet(workdir, env)
+    # Hard wall-clock backstop: a hung federation (the exact bug class
+    # this harness hunts) must fail the run, not the CI job's timeout.
+    watchdog = threading.Timer(timeout_s, fleet.kill_all)
+    watchdog.daemon = True
+
+    records: dict[int, dict] = {}
+    events: list[dict] = []
+    per_client: dict = {}
+    resumed = 0
+    incarnations = 1
+    delivered: list[dict] = []
+    pending = sorted(kills, key=lambda k: (k.after_round, k.target))
+    rc: Optional[int] = None
+
+    try:
+        watchdog.start()
+        host, port = fleet.start_broker(timeout=30.0)
+        worker_cfg = _config_flags(rounds, n_workers, seed)
+        for i in range(n_workers):
+            fleet.start_worker(i, worker_cfg, host, port)
+        coord_cfg = _config_flags(rounds, n_workers, seed,
+                                  checkpoint_dir=ckpt_dir)
+
+        def launch(resume: bool) -> subprocess.Popen:
+            return fleet.start_coordinator(
+                coord_cfg, host, port, n_workers, round_timeout,
+                enroll_timeout, resume=resume)
+
+        coord = launch(resume=False)
+        restart_pending = False
+        while True:
+            line = coord.stderr.readline()
+            if not line:
+                coord.wait()
+                if restart_pending:
+                    restart_pending = False
+                    incarnations += 1
+                    coord = launch(resume=True)
+                    continue
+                rc = coord.returncode
+                break
+            doc = _parse_json(line.strip())
+            if doc is None:
+                continue
+            if "event" in doc:
+                events.append(doc)
+                if doc["event"] == "resumed":
+                    resumed += 1
+                continue
+            if "num_clients_evaluated" in doc:
+                per_client = doc
+                continue
+            if "round" not in doc:
+                continue
+            r = int(doc["round"])
+            records[r] = doc           # last record per round wins
+            if log_fn is not None:
+                log_fn(doc)
+            while pending and pending[0].after_round <= r:
+                spec = pending.pop(0)
+                delivered.append({**dataclasses.asdict(spec),
+                                  "fired_after_round": r})
+                if spec.target == "coordinator":
+                    coord.send_signal(signal.SIGKILL)
+                    restart_pending = True
+                else:
+                    wid = int(spec.target.split(":", 1)[1])
+                    victim = fleet.workers.get(wid)
+                    if victim is not None and victim.poll() is None:
+                        victim.send_signal(signal.SIGKILL)
+                        victim.wait()
+                    if spec.restart:
+                        fleet.start_worker(wid, worker_cfg, host, port)
+    finally:
+        watchdog.cancel()
+        fleet.close()
+
+    if rc is None:
+        raise RuntimeError(
+            f"coordinator never exited cleanly within {timeout_s}s "
+            f"(records for rounds {sorted(records)})")
+
+    recs = [records[r] for r in sorted(records)]
+    return {
+        "rounds_run": len(recs),
+        "records": recs,
+        "completed_rounds": [r["round"] for r in recs
+                             if r["completed"] > 0
+                             and not r.get("skipped_quorum")],
+        "skipped_rounds": [r["round"] for r in recs
+                           if r.get("skipped_quorum")],
+        "evicted": sorted({d for r in recs for d in r.get("evicted", [])}),
+        "weighted_acc": per_client.get("weighted_acc"),
+        "weighted_loss": per_client.get("weighted_loss"),
+        "per_client_acc": per_client.get("per_client", {}),
+        "rounds_resumed": resumed,
+        "coordinator_incarnations": incarnations,
+        "kills": delivered,
+        "events": events,
+        "exit_code": rc,
+        "workdir": workdir,
+    }

@@ -108,6 +108,9 @@ def run_soak(rounds: int = 10, n_workers: int = 4,
     config = config or default_soak_config(n_workers)
     reg = telemetry.get_registry()
     before = {name: reg.counter(name).value for name in _COUNTERS}
+    _LABELED = "fault.injected_total{"
+    labeled_before = {k: v for k, v in reg.snapshot().items()
+                      if k.startswith(_LABELED)}
 
     broker = MessageBroker().start()
     workers = []
@@ -176,6 +179,14 @@ def run_soak(rounds: int = 10, n_workers: int = 4,
             name: reg.counter(name).value - before[name]
             for name in _COUNTERS
         },
+        # Per-(device, kind) injection deltas, worst offender first — the
+        # device/kind labels the injector attaches to fault.injected_total.
+        "top_faults": sorted(
+            ({"label": k[len(_LABELED) - 1:], "count": delta}
+             for k, v in reg.snapshot().items()
+             if k.startswith(_LABELED)
+             and (delta := v - labeled_before.get(k, 0)) > 0),
+            key=lambda t: (-t["count"], t["label"])),
         "faults_fired": dict(plan.fired) if plan is not None else {},
     }
 

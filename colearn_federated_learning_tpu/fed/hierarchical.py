@@ -29,8 +29,10 @@ from typing import Optional
 import numpy as np
 
 from colearn_federated_learning_tpu.data import registry as data_registry
+from colearn_federated_learning_tpu.faults import fileplane, inject
 from colearn_federated_learning_tpu.fed.engine import FederatedLearner
 from colearn_federated_learning_tpu.fed.evaluation import make_eval_fn
+from colearn_federated_learning_tpu.telemetry import registry as _metrics
 from colearn_federated_learning_tpu.utils import pytrees
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 
@@ -122,26 +124,61 @@ class HierarchicalLearner:
         self.history: list[dict] = []
 
     # ------------------------------------------------------------------
-    def _seed_groups(self) -> None:
-        for g in self.groups:
+    def _seed_groups(self, round_idx: Optional[int] = None) -> None:
+        faulted = inject.active_plan() is not None
+        for i, g in enumerate(self.groups):
+            if faulted and fileplane.should_drop(f"g{i}", round_idx,
+                                                 fileplane.HOP_SEED):
+                # Cloud→edge downlink lost: the group keeps training from
+                # its own stale model until the next successful sync.
+                continue
             g.server_state = g.server_state._replace(
                 params=self.global_params
             )
 
-    def _cloud_sync(self) -> None:
-        """Cloud aggregation: example-count-weighted mean of edge models."""
-        self.global_params = self._sync_fn(
-            tuple(g.server_state.params for g in self.groups)
-        )
-        self._seed_groups()
+    def _cloud_sync(self, round_idx: Optional[int] = None) -> list[str]:
+        """Cloud aggregation: example-count-weighted mean of edge models.
+
+        Under an installed FaultPlan, ``drop_silo`` specs keyed by group
+        (``g0``, ``g1``, ...) on hop ``sync`` lose that group's uplink:
+        the cloud mean renormalizes over the survivors (eager fallback —
+        the jit path assumes the full fixed-weight cohort).  Returns the
+        dropped group idents."""
+        if inject.active_plan() is None:
+            self.global_params = self._sync_fn(
+                tuple(g.server_state.params for g in self.groups)
+            )
+            self._seed_groups()
+            return []
+        dropped: list[str] = []
+        alive: list[tuple[float, object]] = []
+        for i, g in enumerate(self.groups):
+            ident = f"g{i}"
+            if fileplane.should_drop(ident, round_idx, fileplane.HOP_SYNC):
+                dropped.append(ident)
+                _metrics.get_registry().counter(
+                    "fed.hier_groups_dropped_total",
+                    labels={"group": ident}).inc()
+                continue
+            alive.append((float(self.group_examples[i]), g.server_state.params))
+        if alive:
+            total = sum(w for w, _ in alive)
+            acc = pytrees.tree_scale(alive[0][1], alive[0][0] / total)
+            for w, p in alive[1:]:
+                acc = pytrees.tree_add(acc, pytrees.tree_scale(p, w / total))
+            self.global_params = acc
+        # else: every uplink lost — the cloud model simply stays stale.
+        self._seed_groups(round_idx)
+        return dropped
 
     def run_round(self) -> dict:
         """One edge round in EVERY group; cloud sync on period boundaries."""
         r = len(self.history)
         recs = [g.run_round() for g in self.groups]
         synced = (r + 1) % self.sync_period == 0
+        dropped: list[str] = []
         if synced:
-            self._cloud_sync()
+            dropped = self._cloud_sync(r)
         out = {
             "round": r,
             "synced": synced,
@@ -149,6 +186,8 @@ class HierarchicalLearner:
             "completed": float(np.sum([x["completed"] for x in recs])),
             "group_losses": [float(x["train_loss"]) for x in recs],
         }
+        if dropped:
+            out["groups_dropped"] = dropped
         self.history.append(out)
         return out
 
@@ -169,8 +208,10 @@ class HierarchicalLearner:
                 # Terminal sync (standard HierFAVG): the reported final
                 # model must fold the groups' last partial period, not a
                 # stale cloud aggregate.
-                self._cloud_sync()
+                dropped = self._cloud_sync(rec["round"])
                 rec["synced"] = True
+                if dropped:
+                    rec["groups_dropped"] = dropped
             if rec["synced"]:
                 loss, acc = self.evaluate()
                 rec["eval_loss"], rec["eval_acc"] = loss, acc

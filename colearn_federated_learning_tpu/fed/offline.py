@@ -12,6 +12,8 @@ without retraining.
 
 from __future__ import annotations
 
+import math
+import zipfile
 from typing import Optional
 
 import jax
@@ -20,22 +22,29 @@ import numpy as np
 
 from colearn_federated_learning_tpu.data import registry as data_registry
 from colearn_federated_learning_tpu.data.sharding import pack_client_shards
+from colearn_federated_learning_tpu.faults import fileplane
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu.models import registry as model_registry
+from colearn_federated_learning_tpu.telemetry import registry as _metrics
 from colearn_federated_learning_tpu.utils import prng, pytrees
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 from colearn_federated_learning_tpu.utils.serialization import (
+    atomic_save_pytree_npz,
     load_pytree_npz,
-    save_pytree_npz,
 )
+
+# Everything a half-written / replayed / foreign update file can raise on
+# load+decode — the aggregator skips (and counts) these, never crashes.
+_BAD_UPDATE_ERRORS = (OSError, EOFError, KeyError, ValueError,
+                      zipfile.BadZipFile)
 
 
 def init_global_model(config: ExperimentConfig, path: str) -> None:
     """Initialize global params from the experiment seed and write them."""
     params = setup_lib.init_global_params(config)
-    save_pytree_npz(path, jax.tree.map(np.asarray, params),
-                    meta={"round": 0, "config": config.run.name})
+    atomic_save_pytree_npz(path, jax.tree.map(np.asarray, params),
+                           meta={"round": 0, "config": config.run.name})
 
 
 def client_update(
@@ -52,6 +61,12 @@ def client_update(
     setup_lib.require_stateless_strategy(c, "the file-based client flow")
     params, meta = load_pytree_npz(global_path)
     round_idx = int(meta.get("round", round_idx))
+
+    silo = str(client_id)
+    if fileplane.should_drop(silo, round_idx, fileplane.HOP_UPDATE):
+        # Injected silo dropout: no update file is published this round.
+        return {"client_id": client_id, "round": round_idx, "weight": 0.0,
+                "dropped": True}
 
     ds = dataset or data_registry.get_dataset(c.data.dataset, seed=c.run.seed)
     labels = np.asarray(ds.y_train)
@@ -86,11 +101,13 @@ def client_update(
     wire, cmeta = compression.compress_delta(
         jax.tree.map(np.asarray, delta), c.fed.compress
     )
-    save_pytree_npz(out_path, wire,
-                    meta={"round": round_idx, "weight": weight,
-                          "client_id": client_id,
-                          "num_examples": int(result.num_examples),
-                          "mean_loss": float(result.mean_loss), **cmeta})
+    umeta = fileplane.stale_meta(
+        {"round": round_idx, "weight": weight, "client_id": client_id,
+         "num_examples": int(result.num_examples),
+         "mean_loss": float(result.mean_loss), **cmeta},
+        silo, round_idx, fileplane.HOP_UPDATE)
+    atomic_save_pytree_npz(out_path, wire, meta=umeta)
+    fileplane.maybe_truncate(out_path, silo, round_idx, fileplane.HOP_UPDATE)
     return {"client_id": client_id, "round": round_idx, "weight": weight,
             "mean_loss": float(result.mean_loss)}
 
@@ -102,7 +119,14 @@ def aggregate_updates(
     out_path: str,
 ) -> dict:
     """`colearn aggregate`: fold silo update files into a new global model
-    using the configured server strategy (fed/strategies.py)."""
+    using the configured server strategy (fed/strategies.py).
+
+    Skip-and-log semantics: a torn, stale, or undecodable update file is
+    skipped (counted as ``fed.offline_updates_rejected_total``, reason in
+    the returned ``rejected`` list) instead of crashing the aggregator.
+    The round only commits when the accepted count reaches the quorum
+    derived from ``fed.min_cohort_fraction``; a sub-quorum round raises
+    with every skip reason embedded."""
     if not update_paths:
         raise ValueError("aggregate_updates: no update files given")
     setup_lib.require_mean_aggregator(config, "the file-based aggregator")
@@ -111,34 +135,63 @@ def aggregate_updates(
 
     from colearn_federated_learning_tpu.fed import compression
 
+    reg = _metrics.get_registry()
     wsum = None
     total_w = 0.0
+    accepted = 0
+    rejected: list[str] = []
+
+    def _reject(why: str, reason: str) -> None:
+        reg.counter("fed.offline_updates_rejected_total",
+                    labels={"reason": reason}).inc()
+        rejected.append(why)
+
     for p in update_paths:
-        delta, umeta = load_pytree_npz(p)
+        try:
+            delta, umeta = load_pytree_npz(p)
+        except _BAD_UPDATE_ERRORS as e:
+            _reject(f"bad update {p}: {type(e).__name__}: {e}", "torn")
+            continue
         # Guard against silent model corruption: an update computed against
         # a different global round must not be folded in.
         if "round" in umeta and int(umeta["round"]) != round_idx:
-            raise ValueError(
-                f"stale update {p}: computed at round {umeta['round']}, "
-                f"global model is at round {round_idx}"
-            )
-        delta = compression.decompress_delta(delta, umeta, shapes=params)
+            _reject(f"stale update {p}: computed at round {umeta['round']}, "
+                    f"global model is at round {round_idx}", "stale")
+            continue
+        try:
+            delta = compression.decompress_delta(delta, umeta, shapes=params)
+        except _BAD_UPDATE_ERRORS as e:
+            _reject(f"bad update {p}: {type(e).__name__}: {e}", "decode")
+            continue
         w = float(umeta.get("weight", 1.0))
+        if w <= 0:
+            _reject(f"bad update {p}: non-positive weight {w}", "weight")
+            continue
         contrib = pytrees.tree_scale(delta, w)
         wsum = contrib if wsum is None else pytrees.tree_add(wsum, contrib)
         total_w += w
-    if total_w <= 0:
-        raise ValueError("aggregate_updates: total weight is zero")
+        accepted += 1
+
+    quorum = max(1, math.ceil(config.fed.min_cohort_fraction
+                              * len(update_paths)))
+    if accepted < quorum:
+        raise ValueError(
+            f"aggregate_updates: only {accepted}/{len(update_paths)} updates "
+            f"usable (quorum {quorum}); " + "; ".join(rejected))
     mean_delta = pytrees.tree_scale(wsum, 1.0 / total_w)
 
     state = strategies.init_server_state(params, config.fed)
     state = strategies.server_update(state, mean_delta, config.fed)
-    save_pytree_npz(out_path, jax.tree.map(np.asarray, state.params),
-                    meta={"round": round_idx + 1, "config": config.run.name,
-                          "num_updates": len(update_paths),
-                          "total_weight": total_w})
-    return {"round": round_idx + 1, "num_updates": len(update_paths),
-            "total_weight": total_w}
+    atomic_save_pytree_npz(out_path, jax.tree.map(np.asarray, state.params),
+                           meta={"round": round_idx + 1,
+                                 "config": config.run.name,
+                                 "num_updates": accepted,
+                                 "total_weight": total_w})
+    out = {"round": round_idx + 1, "num_updates": accepted,
+           "num_rejected": len(rejected), "total_weight": total_w}
+    if rejected:
+        out["rejected"] = rejected
+    return out
 
 
 def evaluate_global(config: ExperimentConfig, global_path: str,

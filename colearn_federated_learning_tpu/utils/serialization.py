@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import struct
+import tempfile
 import zlib
 from typing import Any
 
@@ -112,6 +114,27 @@ def save_pytree_npz(path_or_file, tree: Any, meta: dict | None = None) -> None:
             json.dumps(names).encode(), dtype=np.uint8
         ).copy()
     np.savez(path_or_file, **views)
+
+
+def atomic_save_pytree_npz(path: str, tree: Any,
+                           meta: dict | None = None) -> None:
+    """Crash-safe :func:`save_pytree_npz`: write to a same-directory temp
+    file, fsync, then ``os.replace`` — a reader never observes a torn
+    npz, only the old file or the new one.  The temp file is opened as a
+    file OBJECT because ``np.savez`` silently appends ``.npz`` to bare
+    paths, which would break the replace."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp-", suffix=".npz")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            save_pytree_npz(f, tree, meta)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
 
 
 def load_pytree_npz(path_or_file) -> tuple[Any, dict]:

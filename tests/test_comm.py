@@ -852,3 +852,63 @@ def test_streaming_folder_rejects_add_after_finalize():
     sf.finalize()                       # idempotent
     with pytest.raises(RuntimeError):
         sf.add({"client_id": "1"}, {"w": np.ones((2,), np.float32)})
+
+
+# --------------------------------------------------------- crash resume ----
+def test_coordinator_wal_resume_discards_uncommitted_round(tmp_path):
+    """The coordinator crash window: a WAL entry whose checkpoint never
+    landed marks an uncommitted round — resume discards it (counted),
+    restores the last committed state, and re-runs the round."""
+    import dataclasses
+
+    from colearn_federated_learning_tpu import telemetry
+    from colearn_federated_learning_tpu.ckpt import RoundWal
+
+    cfg = _config(num_clients=2, rounds=4)
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1))
+    reg = telemetry.get_registry()
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(2)]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=2, timeout=20.0)
+            coord.trainers.sort(key=lambda d: int(d.device_id))
+            coord.fit(rounds=2)
+            coord.close()
+
+            # Simulate the kill landing between WAL append and state
+            # save: round 2 is logged but never committed.
+            wal = RoundWal(cfg.run.checkpoint_dir)
+            wal.append({"round": 2, "accepted": [0, 1], "completed": 2,
+                        "total_weight": 0.0})
+            wal.close()
+
+            resumed0 = reg.counter("fed.rounds_resumed_total").value
+            disc0 = reg.counter(
+                "ckpt.wal_uncommitted_discarded_total").value
+            coord2 = FederatedCoordinator(cfg, broker.host, broker.port,
+                                          round_timeout=60.0,
+                                          want_evaluator=False)
+            coord2.enroll(min_devices=2, timeout=20.0)
+            coord2.trainers.sort(key=lambda d: int(d.device_id))
+            step = coord2.restore_checkpoint()
+            assert step == 2 and len(coord2.history) == 2
+            assert reg.counter("fed.rounds_resumed_total").value \
+                == resumed0 + 1
+            assert reg.counter(
+                "ckpt.wal_uncommitted_discarded_total").value == disc0 + 1
+
+            # The discarded round is RE-RUN, not lost: the log converges
+            # back to one committed entry per round.
+            coord2.fit(rounds=1)
+            entries = RoundWal(cfg.run.checkpoint_dir).load()
+            assert [e["round"] for e in entries] == [0, 1, 2]
+            assert sorted(entries[-1]["accepted"]) == [0, 1]
+            coord2.close()
+        finally:
+            for w in workers:
+                w.stop()

@@ -1,0 +1,248 @@
+"""File/hierarchical-plane faults (faults/fileplane.py): hop-keyed
+specs, the atomic exchange writes they prey on, the offline aggregator's
+skip-and-log quorum semantics, and drop_silo coverage for HierFAVG."""
+
+import dataclasses
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.faults import (
+    FaultPlan,
+    FaultSpec,
+    fileplane,
+    inject,
+)
+from tests.test_engine import tiny_config
+
+
+def _counter(name):
+    return telemetry.get_registry().counter(name).value
+
+
+@pytest.fixture
+def clean_interposer():
+    yield
+    inject.uninstall()
+
+
+# ------------------------------------------------------------- keying ----
+def test_spec_hop_keys_one_exchange_leg():
+    spec = FaultSpec(kind="drop_silo", device_id="g1", round=2, hop="sync")
+    assert spec.matches("g1", 2, "sync", hop="sync")
+    assert not spec.matches("g1", 2, "seed", hop="seed")
+    assert not spec.matches("g0", 2, "sync", hop="sync")
+    # No hop on the spec → any hop matches (comm-plane specs unchanged).
+    wild = FaultSpec(kind="drop_silo", device_id="g1", round=2)
+    assert wild.matches("g1", 2, "sync", hop="sync")
+    assert wild.matches("g1", 2, "seed", hop="seed")
+
+
+def test_hop_plan_json_roundtrip_and_determinism():
+    def plan():
+        return FaultPlan([FaultSpec(kind="truncate_file", device_id="s0",
+                                    hop="update", probability=0.5,
+                                    count=0)], seed=3)
+    p = FaultPlan.from_json(plan().to_json())
+    assert p.faults[0].hop == "update"
+    fires = [
+        tuple(bool(q.match("s0", r, "update", kinds=("truncate_file",),
+                           hop="update")) for r in range(16))
+        for q in (plan(), plan())
+    ]
+    assert fires[0] == fires[1]              # seeded gate, not a dice roll
+    assert any(fires[0]) and not all(fires[0])
+
+
+def test_hopless_match_key_is_preserved():
+    # The probability hash key only grows the hop segment when a hop is
+    # given — a pre-hop comm-plane schedule replays bit-identically.
+    def fires(hop_kw):
+        p = FaultPlan([FaultSpec(kind="drop_request", probability=0.5,
+                                 count=0)], seed=5)
+        return tuple(bool(p.match(str(d), r, "train", **hop_kw))
+                     for d in range(4) for r in range(8))
+    assert fires({}) == fires({"hop": fileplane.ANY})
+
+
+# ---------------------------------------------------------- hooks ----
+def test_hooks_are_noops_without_a_plan(tmp_path):
+    inject.uninstall()
+    meta = {"round": 3}
+    assert not fileplane.should_drop("0", 1)
+    assert fileplane.stale_meta(meta, "0", 1) is meta
+    assert not fileplane.maybe_truncate(str(tmp_path / "missing.npz"),
+                                        "0", 1)
+
+
+def test_hooks_fire_and_count_by_device_and_kind(tmp_path, clean_interposer):
+    inject.install(FaultPlan([
+        FaultSpec(kind="drop_silo", device_id="2", round=1, hop="update"),
+        FaultSpec(kind="stale_round", device_id="2", round=1, hop="update"),
+        FaultSpec(kind="truncate_file", device_id="2", round=1,
+                  hop="update"),
+    ], seed=0))
+    before = _counter("fault.injected_total{device=2,kind=drop_silo}")
+
+    assert not fileplane.should_drop("2", 0)           # wrong round
+    assert fileplane.should_drop("2", 1)
+    assert not fileplane.should_drop("2", 1)           # budget spent
+    assert _counter("fault.injected_total{device=2,kind=drop_silo}") \
+        == before + 1
+
+    stamped = fileplane.stale_meta({"round": 1, "weight": 2.0}, "2", 1)
+    assert stamped["round"] == 0 and stamped["weight"] == 2.0
+
+    p = tmp_path / "u.npz"
+    p.write_bytes(b"x" * 100)
+    assert fileplane.maybe_truncate(str(p), "2", 1)
+    assert p.stat().st_size == 50
+
+
+# --------------------------------------------------- offline plane ----
+def test_client_update_drop_silo_publishes_nothing(tmp_path,
+                                                   clean_interposer):
+    from colearn_federated_learning_tpu.fed import offline
+
+    cfg = tiny_config()
+    g0 = str(tmp_path / "g0.npz")
+    offline.init_global_model(cfg, g0)
+    inject.install(FaultPlan([
+        FaultSpec(kind="drop_silo", device_id="0", round=0, hop="update"),
+    ]))
+    out = str(tmp_path / "u0.npz")
+    stats = offline.client_update(cfg, 0, g0, out)
+    assert stats["dropped"] and stats["weight"] == 0.0
+    assert not os.path.exists(out)
+
+
+def test_offline_round_survives_torn_and_stale_updates(tmp_path,
+                                                       clean_interposer):
+    """The acceptance soak: one silo's file is torn mid-write, another
+    replays an old round stamp — the aggregator skips both (counted, with
+    reasons), commits on the surviving quorum, and the output model is a
+    readable, scoreable npz.  Zero torn-file crashes."""
+    from colearn_federated_learning_tpu.fed import offline
+
+    cfg = tiny_config(min_cohort_fraction=0.5)
+    g0 = str(tmp_path / "g0.npz")
+    offline.init_global_model(cfg, g0)
+
+    inject.install(FaultPlan([
+        FaultSpec(kind="truncate_file", device_id="1", round=0,
+                  hop="update"),
+        FaultSpec(kind="stale_round", device_id="2", round=0, hop="update"),
+    ], seed=0))
+    updates = []
+    for cid in range(4):
+        out = str(tmp_path / f"u{cid}.npz")
+        offline.client_update(cfg, cid, g0, out)
+        updates.append(out)
+    inject.uninstall()
+
+    torn0 = _counter("fed.offline_updates_rejected_total{reason=torn}")
+    stale0 = _counter("fed.offline_updates_rejected_total{reason=stale}")
+    g1 = str(tmp_path / "g1.npz")
+    agg = offline.aggregate_updates(cfg, g0, updates, g1)
+    assert agg["num_updates"] == 2 and agg["num_rejected"] == 2
+    assert len(agg["rejected"]) == 2
+    assert any("stale update" in r for r in agg["rejected"])
+    assert _counter(
+        "fed.offline_updates_rejected_total{reason=torn}") == torn0 + 1
+    assert _counter(
+        "fed.offline_updates_rejected_total{reason=stale}") == stale0 + 1
+    # The committed model is whole: evaluable, and no temp files leaked.
+    rec = offline.evaluate_global(cfg, g1)
+    assert rec["round"] == 1 and np.isfinite(rec["eval_loss"])
+    assert glob.glob(str(tmp_path / ".tmp-*")) == []
+
+
+def test_aggregate_raises_below_quorum_with_reasons(tmp_path,
+                                                    clean_interposer):
+    from colearn_federated_learning_tpu.fed import offline
+
+    cfg = tiny_config(min_cohort_fraction=1.0)
+    g0 = str(tmp_path / "g0.npz")
+    offline.init_global_model(cfg, g0)
+    inject.install(FaultPlan([
+        FaultSpec(kind="truncate_file", device_id="0", round=0,
+                  hop="update"),
+    ]))
+    u0, u1 = str(tmp_path / "u0.npz"), str(tmp_path / "u1.npz")
+    offline.client_update(cfg, 0, g0, u0)
+    offline.client_update(cfg, 1, g0, u1)
+    inject.uninstall()
+    with pytest.raises(ValueError, match=r"1/2 updates usable \(quorum 2\)"):
+        offline.aggregate_updates(cfg, g0, [u0, u1],
+                                  str(tmp_path / "g1.npz"))
+
+
+def test_atomic_write_never_leaves_partials_on_error(tmp_path):
+    from colearn_federated_learning_tpu.utils.serialization import (
+        atomic_save_pytree_npz,
+    )
+
+    path = str(tmp_path / "m.npz")
+    with pytest.raises(TypeError):
+        atomic_save_pytree_npz(path, {"layers": [np.zeros(3)]})
+    assert os.listdir(tmp_path) == []      # neither target nor temp file
+
+
+# ----------------------------------------------- hierarchical plane ----
+def _hier(**kw):
+    from tests.test_hierarchical import _cfg
+
+    from colearn_federated_learning_tpu.fed.hierarchical import (
+        HierarchicalLearner,
+    )
+
+    return HierarchicalLearner(_cfg(), num_groups=2, sync_period=2, **kw)
+
+
+def _flat(tree):
+    import jax
+
+    return np.concatenate([np.ravel(np.asarray(a))
+                           for a in jax.tree.leaves(tree)])
+
+
+def test_hier_drop_silo_on_sync_renormalizes(clean_interposer):
+    h = _hier()
+    inject.install(FaultPlan([
+        FaultSpec(kind="drop_silo", device_id="g1", round=1, hop="sync"),
+    ]))
+    before = _counter("fed.hier_groups_dropped_total{group=g1}")
+    hist = h.fit(rounds=2)
+    assert hist[0].get("groups_dropped") is None
+    assert hist[1]["groups_dropped"] == ["g1"]
+    assert _counter("fed.hier_groups_dropped_total{group=g1}") == before + 1
+    # Sole survivor: the cloud model IS group 0's model, and the re-seed
+    # pushed it back into both groups.
+    a = _flat(h.groups[0].server_state.params)
+    np.testing.assert_array_equal(a, _flat(h.global_params))
+    np.testing.assert_array_equal(a, _flat(h.groups[1].server_state.params))
+
+
+def test_hier_drop_silo_on_seed_leaves_group_stale(clean_interposer):
+    h = _hier()
+    inject.install(FaultPlan([
+        FaultSpec(kind="drop_silo", device_id="g0", round=1, hop="seed"),
+    ]))
+    hist = h.fit(rounds=2)
+    # The sync itself succeeded — no uplink was dropped...
+    assert "groups_dropped" not in hist[1]
+    # ...but g0 never received the cloud model back, while g1 did.
+    cloud = _flat(h.global_params)
+    np.testing.assert_array_equal(cloud,
+                                  _flat(h.groups[1].server_state.params))
+    assert np.abs(cloud - _flat(h.groups[0].server_state.params)).max() > 0
+
+
+def test_hier_round_records_unchanged_without_plan():
+    inject.uninstall()
+    h = _hier()
+    hist = h.fit(rounds=2)
+    assert all("groups_dropped" not in r for r in hist)

@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL007), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL008), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -321,6 +321,70 @@ def test_cl007_suppression(tmp_path):
             for d in devs:  # colearn: hot
                 save_pytree_npz(d.path, params)  # colearn: noqa(CL007)
     """)
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL008 ----
+def test_cl008_flags_in_place_exchange_writes_in_fed(tmp_path):
+    res = run_lint(tmp_path, """
+        import numpy as np
+        from pkg.utils.serialization import save_pytree_npz
+
+        def publish(path, tree):
+            save_pytree_npz(path, tree)
+
+        def manifest(path, names):
+            with open(path, "w") as f:
+                f.write("\\n".join(names))
+
+        def raw(path, arrs):
+            np.savez(path, **arrs)
+    """, relpath="pkg/fed/offline.py")
+    assert rule_ids(res) == ["CL008"]
+    assert len(res.findings) == 3
+
+
+def test_cl008_allows_temp_plus_replace_in_same_function(tmp_path):
+    res = run_lint(tmp_path, """
+        import os
+        from pkg.utils.serialization import save_pytree_npz
+
+        def publish(path, tree):
+            tmp = path + ".tmp"
+            save_pytree_npz(tmp, tree)
+            os.replace(tmp, path)
+    """, relpath="pkg/fed/offline.py")
+    assert res.findings == []
+
+
+def test_cl008_ignores_writes_outside_fed(tmp_path):
+    res = run_lint(tmp_path, """
+        def snapshot(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+    """, relpath="pkg/telemetry/dump.py")
+    assert res.findings == []
+
+
+def test_cl008_ignores_reads_and_appends(tmp_path):
+    res = run_lint(tmp_path, """
+        def load(path):
+            with open(path, "rb") as f:
+                return f.read()
+
+        def journal(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+    """, relpath="pkg/fed/offline.py")
+    assert res.findings == []
+
+
+def test_cl008_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def scratch(path, blob):
+            with open(path, "wb") as f:  # colearn: noqa(CL008)
+                f.write(blob)
+    """, relpath="pkg/fed/offline.py")
     assert res.findings == [] and res.suppressed == 1
 
 

@@ -1,0 +1,104 @@
+"""Multi-process chaos soak (faults/procsoak.py): KillSpec validation,
+the baseline-vs-faulted gate logic, and — behind ``-m slow`` — a real
+subprocess federation whose coordinator takes a genuine SIGKILL
+mid-round and must resume from its checkpoint + round WAL."""
+
+import os
+import sys
+
+import pytest
+
+from colearn_federated_learning_tpu.faults.procsoak import (
+    KillSpec,
+    canned_kill_schedule,
+    run_proc_soak,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import chaos_soak_mp  # noqa: E402
+
+
+def test_kill_spec_validation():
+    KillSpec("worker:3", after_round=0)
+    KillSpec("coordinator", after_round=2)
+    with pytest.raises(ValueError, match="target"):
+        KillSpec("broker", after_round=0)
+    with pytest.raises(ValueError, match="target"):
+        KillSpec("worker:x", after_round=0)
+    with pytest.raises(ValueError, match="after_round"):
+        KillSpec("coordinator", after_round=-1)
+    with pytest.raises(ValueError, match="restart"):
+        KillSpec("coordinator", after_round=0, restart=False)
+
+
+def test_canned_schedule_scales_with_run_length():
+    short = canned_kill_schedule(3, 2)
+    assert [k.target for k in short] == ["coordinator"]
+    assert short[0].after_round == 0       # after the first checkpoint
+    full = canned_kill_schedule(6, 3)
+    assert [k.target for k in full] == ["worker:1", "coordinator"]
+    assert full[-1].after_round == 2
+
+
+def _summary(**over):
+    base = dict(exit_code=0, rounds_run=3, rounds_resumed=0, kills=[],
+                weighted_acc=0.8, per_client_acc={"0": 0.8, "1": 0.8})
+    base.update(over)
+    return base
+
+
+def test_check_proc_soak_gate():
+    kills = [KillSpec("coordinator", after_round=0)]
+    ok = chaos_soak_mp.check_proc_soak(
+        _summary(),
+        _summary(rounds_resumed=1,
+                 kills=[{"target": "coordinator", "fired_after_round": 0}]),
+        rounds=3, tol=0.1, kills=kills)
+    assert ok == []
+
+    # Coordinator was killed but never resumed.
+    p = chaos_soak_mp.check_proc_soak(
+        _summary(),
+        _summary(kills=[{"target": "coordinator", "fired_after_round": 0}]),
+        rounds=3, tol=0.1, kills=kills)
+    assert any("never resumed" in x for x in p)
+
+    # A round record was lost across the kill.
+    p = chaos_soak_mp.check_proc_soak(
+        _summary(), _summary(rounds_run=2, rounds_resumed=1,
+                             kills=[{"target": "coordinator"}]),
+        rounds=3, tol=0.1, kills=kills)
+    assert any("rounds were lost" in x for x in p)
+
+    # Accuracy drifted beyond tolerance on the common clients.
+    p = chaos_soak_mp.check_proc_soak(
+        _summary(),
+        _summary(rounds_resumed=1, kills=[{"target": "coordinator"}],
+                 per_client_acc={"0": 0.1, "1": 0.1}, weighted_acc=0.1),
+        rounds=3, tol=0.1, kills=kills)
+    assert any("drifted" in x for x in p)
+
+    # A baseline that resumed means the harness itself is broken.
+    p = chaos_soak_mp.check_proc_soak(
+        _summary(rounds_resumed=1), _summary(rounds_resumed=1,
+                                             kills=[{"t": 1}]),
+        rounds=3, tol=0.1, kills=kills)
+    assert any("baseline resumed" in x for x in p)
+
+
+@pytest.mark.slow
+def test_proc_soak_coordinator_sigkill_resumes(tmp_path):
+    """The acceptance run: 2 workers, 3 rounds, a real SIGKILL to the
+    coordinator process mid-round 1 — the relaunched ``--resume``
+    incarnation must finish the full round budget with a final score."""
+    kills = canned_kill_schedule(3, 2)
+    s = run_proc_soak(rounds=3, n_workers=2, kills=kills,
+                      workdir=str(tmp_path), round_timeout=120.0,
+                      timeout_s=420.0)
+    assert s["exit_code"] == 0
+    assert s["rounds_run"] == 3
+    assert s["rounds_resumed"] >= 1
+    assert s["coordinator_incarnations"] == 2
+    assert len(s["kills"]) == 1
+    assert s["weighted_acc"] is not None
