@@ -59,12 +59,20 @@ COUNTERS = (
     "async.dispatch_failures",
     "async.aggregations_total",
     "async.updates_discarded_stale",
+    # fleet simulation (fleetsim/sim.py)
+    "fleetsim.rounds_total",
+    "fleetsim.clients_trained_total",
+    "fleetsim.bytes_up_est_total",     # wire-codec frame estimate, uplink
+    "fleetsim.bytes_down_est_total",   # wire-codec frame estimate, downlink
 )
 
 # Gauges -------------------------------------------------------------------
 GAUGES = (
     "engine.h2d_transfer_s",
     "local.steps_per_round",
+    "fleetsim.devices",
+    "fleetsim.chunk_size",
+    "fleetsim.available_fraction",
 )
 
 # Histograms ---------------------------------------------------------------
@@ -74,6 +82,7 @@ HISTOGRAMS = (
     "engine.round_time_s",
     "fed.round_time_s",
     "async.agg_time_s",
+    "fleetsim.round_time_s",
 )
 
 # Counters whose soak-window delta faults/soak.py reports (a curated

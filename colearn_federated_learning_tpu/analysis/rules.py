@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL008).
+"""The colearn rule set (CL001–CL009).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -438,3 +438,72 @@ class NonAtomicExchangeWrite(Rule):
                 f"{writer} writes an exchange file in place: a reader or "
                 "kill mid-write sees a torn artifact; use temp file + "
                 "os.replace")
+
+
+# ----------------------------------------------------------------- CL009 --
+@register
+class PerClientLoopInFleetHotPath(Rule):
+    """fleetsim exists to make simulated clients a ``jax.vmap`` axis
+    (fleetsim/sim.py): the ONLY Python loop a hot fleet path may contain
+    iterates over fixed-size CHUNKS, each dispatching one jitted vmapped
+    step.  A per-client/per-device Python loop — or a ``local_update``
+    call per iteration — re-creates the one-at-a-time engine inside the
+    subsystem built to kill it, and at fleet scale turns a ~250-dispatch
+    million-client round into a million dispatches."""
+
+    id = "CL009"
+    title = "per-client Python loop in a fleetsim hot path"
+    hint = ("make clients a vmap axis: materialize the chunk and call the "
+            "jitted chunk step once per CHUNK (see fleetsim/sim."
+            "FleetSim.run_round); mark a justified host-side loop with "
+            "`# colearn: noqa(CL009)`")
+
+    _TRAINERS = {"local_update", "scaffold_update"}
+    _WORDS = ("client", "device")
+    _LOOPS = (ast.For, ast.While, ast.ListComp, ast.SetComp, ast.DictComp,
+              ast.GeneratorExp)
+
+    def _idents(self, node: ast.AST) -> Iterator[str]:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                yield n.id
+            elif isinstance(n, ast.Attribute):
+                yield n.attr
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("fleetsim"):
+            return
+        hot = ctx.hot_lines()
+        if not hot:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, self._LOOPS) and node.lineno in hot):
+                continue
+            # (a) the loop head names a per-client/per-device quantity.
+            if isinstance(node, ast.For):
+                head: tuple = (node.target, node.iter)
+            elif isinstance(node, ast.While):
+                head = (node.test,)
+            else:
+                head = tuple(part for comp in node.generators
+                             for part in (comp.target, comp.iter))
+            per_client = [i for h in head for i in self._idents(h)
+                          if any(w in i.lower() for w in self._WORDS)]
+            if per_client:
+                yield self.finding(
+                    ctx, node,
+                    f"`# colearn: hot` loop iterates per "
+                    f"{per_client[0]!r}: clients must be a vmap axis — "
+                    "loop over chunks")
+                continue
+            # (b) one local-training call per iteration.
+            for inner in ast.walk(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                tail = dotted_name(inner.func).rsplit(".", 1)[-1]
+                if tail in self._TRAINERS:
+                    yield self.finding(
+                        ctx, inner,
+                        f"{tail}() called once per iteration of a "
+                        "`# colearn: hot` loop; vmap it over the chunk "
+                        "instead")

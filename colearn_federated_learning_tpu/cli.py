@@ -558,6 +558,71 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     return 0 if ok else 1
 
 
+def cmd_fleetsim(args: argparse.Namespace) -> int:
+    """Simulated-fleet training: chunked-vmap rounds over a seeded
+    synthetic population with an arrival-process traffic model
+    (fleetsim/) — per-round records on stderr, summary JSON on stdout."""
+    from colearn_federated_learning_tpu import fleetsim
+    from colearn_federated_learning_tpu.utils.config import (
+        FedConfig,
+        ModelConfig,
+        RunConfig,
+    )
+
+    spec = fleetsim.PopulationSpec(
+        num_devices=args.devices, num_classes=args.classes,
+        feature_dim=args.feature_dim, shard_capacity=args.capacity,
+        label_skew=args.label_skew, seed=args.seed)
+    population = fleetsim.DevicePopulation(spec)
+    traffic = fleetsim.TrafficModel(
+        fleetsim.TrafficSpec(base_rate=args.base_rate,
+                             diurnal_amplitude=args.diurnal,
+                             round_minutes=args.round_minutes,
+                             seed=args.seed),
+        spec.num_devices)
+    config = ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=spec.num_classes,
+                          hidden_dim=args.hidden_dim, depth=args.depth),
+        fed=FedConfig(strategy=args.strategy, local_steps=args.local_steps,
+                      batch_size=args.batch_size, lr=args.lr,
+                      compress=args.compress,
+                      compress_down=args.compress_down or "none"),
+        run=RunConfig(name="fleetsim", seed=args.seed))
+    plan = None
+    if args.fault_plan:
+        from colearn_federated_learning_tpu import faults
+
+        plan = faults.FaultPlan.load(args.fault_plan,
+                                     seed=args.fault_seed or None)
+    sim = fleetsim.FleetSim.from_population(
+        config, population, traffic, cohort_size=args.cohort,
+        chunk_size=args.chunk, fault_plan=plan)
+    history = sim.fit(
+        args.rounds,
+        log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr))
+    wall = sum(r["round_time_s"] for r in history) or 1e-9
+    clients = sum(r["clients_trained"] for r in history)
+    summary = {
+        "devices": spec.num_devices,
+        "cohort": args.cohort,
+        "chunk": sim.chunk_size,
+        "rounds": len(history),
+        "clients_trained": clients,
+        "rounds_per_sec": len(history) / wall,
+        "clients_per_sec": clients / wall,
+        "bytes_up_per_round": (
+            sum(r["bytes_up_est"] for r in history) / len(history)),
+        "bytes_down_per_round": (
+            sum(r["bytes_down_est"] for r in history) / len(history)),
+        "dropped": sum(r["dropped"] for r in history),
+        "straggled": sum(r["straggled"] for r in history),
+        "corrupted": sum(r["corrupted"] for r in history),
+        "train_loss": history[-1]["train_loss"],
+    }
+    print(json.dumps(summary))
+    return 0 if history and clients > 0 else 1
+
+
 def cmd_lint(args: argparse.Namespace) -> int:
     """Run the AST lint (analysis/) — CPU-only, never initializes jax."""
     import os
@@ -777,9 +842,50 @@ def main(argv: list[str] | None = None) -> int:
                               "federation is killed and reported")
     p_chaos.set_defaults(fn=cmd_chaos)
 
+    p_fleet = sub.add_parser("fleetsim",
+                             help="simulate a 1k-1M device fleet: chunked "
+                                  "vmap rounds over a synthetic population "
+                                  "with a traffic model (fleetsim/)")
+    p_fleet.add_argument("--devices", type=int, default=10_000)
+    p_fleet.add_argument("--cohort", type=int, default=1024)
+    p_fleet.add_argument("--rounds", type=int, default=5)
+    p_fleet.add_argument("--chunk", type=int, default=1024,
+                         help="vmap chunk size: memory is O(chunk), wall "
+                              "time is O(cohort/chunk) dispatches")
+    p_fleet.add_argument("--seed", type=int, default=0)
+    p_fleet.add_argument("--classes", type=int, default=10)
+    p_fleet.add_argument("--feature-dim", type=int, default=32)
+    p_fleet.add_argument("--capacity", type=int, default=32,
+                         help="padded per-device shard size")
+    p_fleet.add_argument("--label-skew", type=float, default=0.7,
+                         help="P(label == device home class); non-IID knob")
+    p_fleet.add_argument("--base-rate", type=float, default=2.0,
+                         help="mean device check-ins per hour")
+    p_fleet.add_argument("--diurnal", type=float, default=0.8,
+                         help="day/night availability swing in [0, 1]")
+    p_fleet.add_argument("--round-minutes", type=float, default=10.0)
+    p_fleet.add_argument("--strategy", default="fedavg",
+                         choices=["fedavg", "fedprox", "fedadam", "fedyogi"])
+    p_fleet.add_argument("--local-steps", type=int, default=4)
+    p_fleet.add_argument("--batch-size", type=int, default=16)
+    p_fleet.add_argument("--lr", type=float, default=0.05)
+    p_fleet.add_argument("--hidden-dim", type=int, default=64)
+    p_fleet.add_argument("--depth", type=int, default=2)
+    p_fleet.add_argument("--compress", default="none",
+                         choices=["none", "int8", "topk"],
+                         help="uplink scheme for the byte estimates")
+    p_fleet.add_argument("--compress-down", default="none",
+                         choices=["none", "int8", "topk"])
+    p_fleet.add_argument("--fault-plan", default=None,
+                         help="JSON fault plan; (device, round, op='train') "
+                              "keys drive per-simulated-device drop/"
+                              "straggle/corrupt")
+    p_fleet.add_argument("--fault-seed", type=int, default=None)
+    p_fleet.set_defaults(fn=cmd_fleetsim)
+
     p_lint = sub.add_parser("lint",
                             help="run the AST invariant checks "
-                                 "(CL001-CL008; analysis/) — fast, "
+                                 "(CL001-CL009; analysis/) — fast, "
                                  "CPU-only, no jax init")
     p_lint.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the installed "

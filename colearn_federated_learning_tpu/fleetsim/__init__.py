@@ -1,0 +1,36 @@
+"""Million-device fleet simulation (ROADMAP "Million-device fleet
+simulation as a first-class workload").
+
+Real sockets cap the mp chaos soak at a handful of workers; this package
+simulates 1k -> 1M clients per host by making clients a ``jax.vmap``
+axis over fixed-size chunks of ``fed/local.py``'s ``local_update``:
+
+- :mod:`.population` — seeded synthetic device population; non-IID data
+  shards are materialized on demand from per-device keys (memory stays
+  O(chunk), never O(fleet));
+- :mod:`.traffic` — arrival-process availability (Poisson base rate x
+  diurnal modulation) driving cohort sampling from available devices;
+- :mod:`.sim` — the chunked-vmap round loop, reusing the engine's
+  aggregation semantics and FaultPlan keys ``(device, round, op)`` for
+  per-simulated-device drop/straggle/corrupt faults.
+"""
+
+from colearn_federated_learning_tpu.fleetsim.population import (
+    DevicePopulation,
+    PopulationSpec,
+    SpeedClass,
+)
+from colearn_federated_learning_tpu.fleetsim.sim import FleetSim
+from colearn_federated_learning_tpu.fleetsim.traffic import (
+    TrafficModel,
+    TrafficSpec,
+)
+
+__all__ = [
+    "DevicePopulation",
+    "PopulationSpec",
+    "SpeedClass",
+    "FleetSim",
+    "TrafficModel",
+    "TrafficSpec",
+]

@@ -1,0 +1,438 @@
+"""The fleet-simulation hot path: chunked ``jax.vmap`` over local_update.
+
+One simulated round is exactly the engine's round — same per-(client,
+round) PRNG keys (utils/prng.py), same FedAvg weighting
+(``num_examples * contrib``), same mean + ``strategies.server_update``
+epilogue — but the cohort is processed in FIXED-SIZE chunks:
+
+    cohort -> [chunk_0 | chunk_1 | ...]      (last chunk zero-padded)
+    chunk_i: vmap(local_update) -> weighted partial sums (on device)
+    fold:    partial sums add into the round accumulator (on device)
+
+Memory is therefore O(chunk x model + chunk x shard) at ANY cohort
+size: a million-client round is ~250 chunk dispatches, not a million-
+row vmap.  Chunk partial sums fold with the same ``tree_weighted_sum``
+semantics the engine aggregates with, so a one-chunk round reproduces
+the engine bit-for-bit (tests/test_fleetsim.py parity tests).
+
+Faults reuse the FaultPlan key space ``(device, round, op)`` with
+``op="train"`` (faults/plan.py):
+
+- ``drop_request``    — the device never trains or reports (no uplink);
+- ``delay``           — straggle: the device loses ``ms`` of its
+  simulated round deadline, its ``step_budget`` shrinks proportionally
+  (fed/local.py masks the lost steps; below the completion threshold
+  its FedAvg weight zeroes exactly like an engine straggler);
+- ``corrupt_payload`` — the update arrives corrupted and is discarded
+  (uplink bytes spent, weight zeroed — the CRC-reject analog).
+
+NOTE on plan authoring: ``FaultSpec.count`` defaults to 1 (one firing
+TOTAL); fleet-wide schedules want explicit ``count=0`` (unlimited) or a
+budget sized to the cohort.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.fed import compression
+from colearn_federated_learning_tpu.fed import setup as setup_lib
+from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu.fed.programs import _rank_cohort
+from colearn_federated_learning_tpu.utils import prng, pytrees
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+from colearn_federated_learning_tpu.utils.serialization import (
+    wire_frame_length,
+)
+
+_FLEET_FAULT_KINDS = ("drop_request", "delay", "corrupt_payload")
+
+
+def _validate_fleet_config(config: ExperimentConfig) -> None:
+    """The fleet path is the engine's plain weighted-mean FedAvg family;
+    the stateful/privacy variants keep their engine-only homes."""
+    setup_lib.require_stateless_strategy(config, "fleetsim")
+    setup_lib.require_mean_aggregator(config, "fleetsim")
+    c = config.fed
+    if c.dp_clip > 0.0 or c.secure_agg:
+        raise NotImplementedError(
+            "fleetsim does not support dp/secure-agg hooks yet: their "
+            "noise accounting and mask pairing assume the engine's "
+            "single-program cohort; run the on-device engine")
+
+
+def _count_fault(kind: str) -> None:
+    """Fault-plane telemetry, aggregate only: the comm injector labels
+    ``fault.injected_total`` per device, but at fleet scale per-device
+    label children would grow the registry O(cohort) per round."""
+    reg = telemetry.get_registry()
+    reg.counter("fault.injected_total", labels={"kind": kind}).inc()
+    reg.counter(f"fault.injected.{kind}").inc()
+
+
+class FleetSim:
+    """Chunked-vmap fleet simulator.
+
+    Build with :meth:`from_population` (synthetic fleet + traffic model,
+    the 1k->1M workload) or :meth:`from_learner` (wrap an existing
+    :class:`~fed.engine.FederatedLearner`'s data/trainer/keys — the
+    parity harness the tests trust the vmapped path against).
+    """
+
+    def __init__(
+        self,
+        *,
+        config: ExperimentConfig,
+        local_update: Callable,
+        num_steps: int,
+        base_key,
+        server_state,
+        shard_fn: Callable[[np.ndarray], tuple],
+        budget_fn: Callable[[np.ndarray], np.ndarray],
+        select_fn: Callable[[int], np.ndarray],
+        num_devices: int,
+        cohort_size: int,
+        chunk_size: int = 1024,
+        fault_plan=None,
+        round_deadline_ms: float = 1000.0,
+        available_fraction_fn: Optional[Callable[[int], float]] = None,
+    ):
+        _validate_fleet_config(config)
+        self.config = config
+        self.local_update = local_update
+        self.num_steps = int(num_steps)
+        self.base_key = base_key
+        self.server_state = server_state
+        self._shard_fn = shard_fn
+        self._budget_fn = budget_fn
+        self._select_fn = select_fn
+        self.num_devices = int(num_devices)
+        self.cohort_size = int(min(cohort_size, num_devices))
+        self.chunk_size = int(min(chunk_size, max(1, self.cohort_size)))
+        self.fault_plan = fault_plan
+        self.round_deadline_ms = float(round_deadline_ms)
+        self._available_fraction_fn = available_fraction_fn
+        self.history: list[dict] = []
+        self.tracer = telemetry.Tracer(process="fleetsim", enabled=False)
+
+        self._chunk_fn = self._build_chunk_fn()
+        self._finish_fn = self._build_finish_fn()
+        # One fused add per fold: the 4 partial sums are one pytree.
+        self._fold_fn = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+
+        # Wire-cost model (comm codecs, shape-only so computed ONCE):
+        # frame lengths depend on leaf shapes/dtypes, not values.
+        params_np = jax.tree.map(np.asarray, server_state.params)
+        zeros = jax.tree.map(np.zeros_like, params_np)
+        self.down_full_bytes = int(wire_frame_length(
+            params_np, {"round": 0, "down": "full"}))
+        scheme_down = config.fed.compress_down
+        if scheme_down == "none":
+            self.down_frame_bytes = self.down_full_bytes
+        else:
+            wire, meta = compression.compress_delta(zeros, scheme_down)
+            self.down_frame_bytes = int(wire_frame_length(
+                wire, {"round": 0, "down": "delta", **meta}))
+        wire_up, meta_up = compression.compress_delta(
+            zeros, config.fed.compress)
+        self.up_frame_bytes = int(wire_frame_length(
+            wire_up, {"round": 0, "op": "train", **meta_up}))
+
+        reg = telemetry.get_registry()
+        reg.gauge("fleetsim.devices").set(self.num_devices)
+        reg.gauge("fleetsim.chunk_size").set(self.chunk_size)
+
+    # ------------------------------------------------------ constructors --
+    @classmethod
+    def from_population(
+        cls,
+        config: ExperimentConfig,
+        population,
+        traffic,
+        cohort_size: int,
+        chunk_size: int = 1024,
+        fault_plan=None,
+        round_deadline_ms: float = 1000.0,
+    ) -> "FleetSim":
+        """Synthetic fleet: shards materialize on demand from per-device
+        keys (fleetsim/population.py); the traffic model picks each
+        round's cohort among currently-available devices."""
+        from colearn_federated_learning_tpu.models import (
+            registry as model_registry,
+        )
+
+        spec = population.spec
+        model = model_registry.build_model(
+            setup_lib.local_model_config(config.model))
+        example_x = jnp.asarray(
+            population.example_batch(config.fed.batch_size))
+        base_key = prng.experiment_key(config.run.seed)
+        params = model_registry.init_params(
+            model, example_x, prng.init_key(base_key))
+        local_update, num_steps = setup_lib.local_trainer_for_config(
+            config, model.apply, spec.shard_capacity)
+        return cls(
+            config=config,
+            local_update=local_update,
+            num_steps=num_steps,
+            base_key=base_key,
+            server_state=strategies.init_server_state(params, config.fed),
+            shard_fn=population.materialize,
+            budget_fn=lambda ids: population.step_budgets(ids, num_steps),
+            select_fn=lambda r: traffic.sample_cohort(r, cohort_size),
+            num_devices=spec.num_devices,
+            cohort_size=cohort_size,
+            chunk_size=chunk_size,
+            fault_plan=fault_plan,
+            round_deadline_ms=round_deadline_ms,
+            available_fraction_fn=lambda r: float(
+                traffic.available_mask(r).mean()),
+        )
+
+    @classmethod
+    def from_learner(cls, learner, chunk_size: int = 1024,
+                     fault_plan=None,
+                     round_deadline_ms: float = 1000.0) -> "FleetSim":
+        """Wrap a vmap-path :class:`FederatedLearner`: same shards, same
+        trainer closure, same base key, same host cohort ranking — the
+        ONLY difference from ``learner.run_round()`` is the chunked
+        dispatch, which is exactly what the parity tests pin down."""
+        if learner.mesh is not None:
+            raise NotImplementedError(
+                "from_learner wraps the single-device vmap path; shard "
+                "the fleet over a mesh via the engine instead")
+        shards = learner.shards
+        counts_dev = jnp.asarray(shards.counts)
+        num_clients = learner.num_clients
+        cohort = learner.cohort_size
+        base_key = learner.base_key
+
+        def select(round_idx: int) -> np.ndarray:
+            # Mirrors fed/engine._host_sample_cohort (vmap branch): same
+            # key, same ranking function, eager.
+            if cohort < num_clients:
+                skey = prng.sampling_key(
+                    base_key, jnp.asarray(round_idx, jnp.int32))
+                return np.asarray(
+                    _rank_cohort(skey, counts_dev, cohort)).astype(np.int64)
+            return np.arange(num_clients, dtype=np.int64)
+
+        def shard_slices(ids: np.ndarray) -> tuple:
+            return shards.x[ids], shards.y[ids], shards.counts[ids]
+
+        num_steps = learner.num_steps
+        return cls(
+            config=learner.config,
+            local_update=learner.local_update,
+            num_steps=num_steps,
+            base_key=base_key,
+            server_state=learner.server_state,
+            shard_fn=shard_slices,
+            budget_fn=lambda ids: np.full(
+                ids.shape[0], num_steps, np.int32),
+            select_fn=select,
+            num_devices=num_clients,
+            cohort_size=cohort,
+            chunk_size=chunk_size,
+            fault_plan=fault_plan,
+            round_deadline_ms=round_deadline_ms,
+        )
+
+    # -------------------------------------------------- compiled pieces --
+    def _build_chunk_fn(self):
+        """One chunk's training + weighting, jit-compiled once (static
+        chunk shape): vmap(local_update) -> weighted partial sums.  The
+        engine's cohort_step semantics, minus the engine-only hooks the
+        config validator excluded."""
+        update = self.local_update
+        fed = self.config.fed
+        num_steps = self.num_steps
+
+        def chunk_fn(key, params, x, y, counts, ids, round_idx, budgets,
+                     keep):
+            # Per-(client, round) keys off the GLOBAL device id:
+            # placement/chunking-independent determinism (utils/prng.py).
+            keys = jax.vmap(
+                lambda i: prng.client_round_key(key, i, round_idx))(ids)
+            if fed.straggler_prob > 0.0:
+                # The engine's simulated stragglers, same derivation
+                # (fed/programs.cohort_step); the fleet's own budget
+                # (speed class / delay fault) caps from below.
+                skey = prng.straggler_key(key, round_idx)
+
+                def budget_for(i):
+                    k = jax.random.fold_in(skey, i)
+                    slow = jax.random.bernoulli(k, fed.straggler_prob)
+                    frac = jax.random.uniform(jax.random.fold_in(k, 1))
+                    return jnp.where(
+                        slow, (frac * num_steps).astype(jnp.int32),
+                        num_steps)
+
+                budgets = jnp.minimum(budgets, jax.vmap(budget_for)(ids))
+            lr_scale = strategies.lr_scale_for_round(fed, round_idx)
+            res = jax.vmap(
+                update, in_axes=(None, 0, 0, 0, 0, 0, None)
+            )(params, x, y, counts, keys, budgets, lr_scale)
+            contrib = res.completed & (res.num_examples > 0) & keep
+            weights = res.num_examples.astype(jnp.float32) * contrib
+            wsum = pytrees.tree_weighted_sum(res.delta, weights)
+            total_w = jnp.sum(weights)
+            loss_sum = jnp.sum(res.mean_loss * weights)
+            n_comp = jnp.sum(contrib.astype(jnp.int32))
+            return wsum, total_w, loss_sum, n_comp
+
+        return jax.jit(chunk_fn)
+
+    def _build_finish_fn(self):
+        """The engine's round epilogue (fed/programs.finish_round, plain
+        path): zero-contributor rounds are a no-op server update."""
+        fed = self.config.fed
+
+        def finish(server_state, wsum, total_w, loss_sum, n_comp):
+            denom = jnp.where(total_w > 0, total_w, 1.0)
+            mean_delta = pytrees.tree_scale(
+                wsum, jnp.where(total_w > 0, 1.0 / denom, 0.0))
+            new_state = strategies.server_update(server_state, mean_delta,
+                                                 fed)
+            metrics = {
+                "train_loss": loss_sum / denom,
+                "completed": n_comp,
+                "total_weight": total_w,
+            }
+            return new_state, metrics
+
+        return jax.jit(finish)
+
+    def _zero_acc(self):
+        wsum = jax.tree.map(
+            lambda l: jnp.zeros(l.shape, jnp.float32),
+            self.server_state.params)
+        return (wsum, jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+
+    # ------------------------------------------------------------ faults --
+    def _resolve_faults(self, ids: np.ndarray, round_idx: int):
+        """Host-side fault resolution for the round cohort: one
+        ``plan.match`` per cohort device on the ``(device, round,
+        op="train")`` key — the same key space the transport injector
+        consumes (faults/inject.py), so one plan drives every plane.
+        Returns ``(keep_weight, budget_scale_ms, uplink_ok, stats)``."""
+        n = ids.shape[0]
+        keep = np.ones(n, bool)          # contributes to the aggregate
+        uplink = np.ones(n, bool)        # spends uplink bytes
+        trains = np.ones(n, bool)        # runs local training at all
+        lost_ms = np.zeros(n, np.float64)
+        plan = self.fault_plan
+        if plan is None:
+            from colearn_federated_learning_tpu.faults import inject
+
+            plan = inject.active_plan()
+        stats = {"dropped": 0, "straggled": 0, "corrupted": 0}
+        if plan is None:
+            return keep, trains, uplink, lost_ms, stats
+        for j in range(n):
+            fired = plan.match(str(int(ids[j])), round_idx, "train",
+                               kinds=_FLEET_FAULT_KINDS, site="server")
+            for f in fired:
+                _count_fault(f.kind)
+                if f.kind == "drop_request":
+                    keep[j] = uplink[j] = trains[j] = False
+                    stats["dropped"] += 1
+                elif f.kind == "delay":
+                    lost_ms[j] += f.ms
+                    stats["straggled"] += 1
+                elif f.kind == "corrupt_payload":
+                    keep[j] = False
+                    stats["corrupted"] += 1
+        return keep, trains, uplink, lost_ms, stats
+
+    # ------------------------------------------------------------- round --
+    def run_round(self) -> dict:
+        """One simulated federated round over a traffic-sampled cohort."""
+        r = len(self.history)
+        t0 = time.perf_counter()
+        reg = telemetry.get_registry()
+        with self.tracer.span("fleet_round", round=r):
+            with self.tracer.span("cohort_sample", round=r):
+                ids = np.asarray(self._select_fn(r), np.int64)
+            keep_w, trains, uplink, lost_ms, fstats = self._resolve_faults(
+                ids, r)
+            budgets = self._budget_fn(ids).astype(np.int32)
+            if np.any(lost_ms > 0):
+                frac = np.clip(1.0 - lost_ms / self.round_deadline_ms,
+                               0.0, 1.0)
+                budgets = np.minimum(
+                    budgets, np.floor(frac * self.num_steps)).astype(
+                        np.int32)
+            # Dropped devices never train: zero budget AND zero weight
+            # (the masked scan still runs their lane — shapes are
+            # static — but no step executes and nothing aggregates).
+            budgets = np.where(trains, budgets, 0)
+
+            n = ids.shape[0]
+            chunk = self.chunk_size
+            padded = max(chunk, ((n + chunk - 1) // chunk) * chunk)
+            ids_pad = np.zeros(padded, np.int64)
+            ids_pad[:n] = ids
+            keep_pad = np.zeros(padded, bool)
+            keep_pad[:n] = keep_w
+            bud_pad = np.zeros(padded, np.int32)
+            bud_pad[:n] = budgets
+
+            params = self.server_state.params
+            acc = self._zero_acc()
+            r_dev = jnp.asarray(r, jnp.int32)
+            with self.tracer.span("train_chunks", round=r, cohort=n,
+                                  chunks=padded // chunk):
+                if n:
+                    for lo in range(0, padded, chunk):  # colearn: hot
+                        sl = slice(lo, lo + chunk)
+                        cx, cy, cc = self._shard_fn(ids_pad[sl])
+                        part = self._chunk_fn(
+                            self.base_key, params, cx, cy, cc,
+                            ids_pad[sl], r_dev, bud_pad[sl], keep_pad[sl])
+                        acc = self._fold_fn(acc, part)
+            with self.tracer.span("server_update", round=r):
+                self.server_state, metrics = self._finish_fn(
+                    self.server_state, *acc)
+                out = {k: float(v)
+                       for k, v in jax.device_get(metrics).items()}
+
+        n_trained = int(trains.sum())
+        n_reporting = int(uplink.sum())
+        bytes_down = n_trained * self.down_frame_bytes
+        bytes_up = n_reporting * self.up_frame_bytes
+        out.update(
+            round=r,
+            cohort=n,
+            cohort_requested=self.cohort_size,
+            clients_trained=n_trained,
+            bytes_down_est=bytes_down,
+            bytes_up_est=bytes_up,
+            **fstats,
+        )
+        if self._available_fraction_fn is not None:
+            frac = self._available_fraction_fn(r)
+            out["available_fraction"] = frac
+            reg.gauge("fleetsim.available_fraction").set(frac)
+        out["round_time_s"] = time.perf_counter() - t0
+        reg.counter("fleetsim.rounds_total").inc()
+        reg.counter("fleetsim.clients_trained_total").inc(n_trained)
+        reg.counter("fleetsim.bytes_down_est_total").inc(bytes_down)
+        reg.counter("fleetsim.bytes_up_est_total").inc(bytes_up)
+        reg.histogram("fleetsim.round_time_s").observe(out["round_time_s"])
+        self.history.append(out)
+        return out
+
+    def fit(self, rounds: int, log_fn=None) -> list[dict]:
+        for _ in range(rounds):
+            rec = self.run_round()
+            if log_fn is not None:
+                log_fn(rec)
+        return self.history

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Fleet-scale round bench: chunked-vmap rounds from 1k to 1M clients.
+
+Sweeps cohort size over the fleetsim subsystem (one FleetSim per sweep
+point, devices == cohort so every round trains the full requested
+cohort) and records per point:
+
+- ``rounds_per_sec`` / ``clients_per_sec`` — the scale headline: wall
+  time is O(cohort / chunk) jitted dispatches, memory O(chunk);
+- ``bytes_up_per_round`` / ``bytes_down_per_round`` — wire-codec frame
+  estimates (utils.serialization.wire_frame_length x cohort), the
+  measurable scale axis for the ROADMAP compression items;
+- compile-excluded mean round time (round 0 is the warmup).
+
+One JSON line per sweep point is appended to
+``results/fleet_bench.jsonl`` (PERF.md "Fleet scale sweep" reads from
+there).
+
+Usage (CPU):
+    JAX_PLATFORMS=cpu python scripts/bench_fleet.py
+    JAX_PLATFORMS=cpu python scripts/bench_fleet.py \\
+        --cohorts 1000,10000 --rounds 3 --chunk 2048
+CI smoke:
+    JAX_PLATFORMS=cpu python scripts/bench_fleet.py --cohorts 64,256 \\
+        --rounds 2 --chunk 64 --check-schema --out results/fleet_ci.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Schema contract for every row this bench writes; --check-schema (CI)
+# asserts it over the output file.
+ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "cohort": int,
+    "chunk": int,
+    "rounds": int,
+    "clients_trained": int,
+    "rounds_per_sec": float,
+    "clients_per_sec": float,
+    "bytes_up_per_round": int,
+    "bytes_down_per_round": int,
+    "round_time_s_mean": float,
+    "round_time_s_warmup": float,
+    "train_loss": float,
+    "param_count": int,
+    "bench_wall_s": float,
+}
+
+
+def bench_config(feature_dim: int, num_classes: int):
+    """A deliberately small model: the bench measures the per-client
+    dispatch machinery, so the model just has to be non-trivial (two
+    dense layers), not accurate."""
+    from colearn_federated_learning_tpu.utils.config import (
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        RunConfig,
+    )
+
+    return ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=num_classes,
+                          hidden_dim=32, depth=1),
+        fed=FedConfig(strategy="fedavg", local_steps=2, batch_size=8,
+                      lr=0.05, momentum=0.0),
+        run=RunConfig(name="bench_fleet", backend="cpu", seed=0),
+    )
+
+
+def run_point(cohort: int, rounds: int, chunk: int, seed: int) -> dict:
+    import jax
+    import numpy as np
+
+    from colearn_federated_learning_tpu import fleetsim
+
+    spec = fleetsim.PopulationSpec(
+        num_devices=cohort, num_classes=10, feature_dim=16,
+        shard_capacity=16, min_examples=4, seed=seed)
+    population = fleetsim.DevicePopulation(spec)
+    # High base rate -> ~every device available: the sweep measures
+    # throughput at the REQUESTED cohort, not the traffic model.
+    traffic = fleetsim.TrafficModel(
+        fleetsim.TrafficSpec(base_rate=2000.0, diurnal_amplitude=0.0,
+                             seed=seed),
+        spec.num_devices)
+    config = bench_config(spec.feature_dim, spec.num_classes)
+    sim = fleetsim.FleetSim.from_population(
+        config, population, traffic, cohort_size=cohort, chunk_size=chunk)
+
+    t0 = time.time()
+    history = sim.fit(rounds + 1)          # round 0 pays the jit compile
+    wall = time.time() - t0
+    measured = history[1:]
+    times = [r["round_time_s"] for r in measured]
+    clients = sum(r["clients_trained"] for r in measured)
+    span = sum(times) or 1e-9
+    params = jax.tree.leaves(sim.server_state.params)
+    return {
+        "bench": "fleet_round",
+        "devices": spec.num_devices,
+        "cohort": cohort,
+        "chunk": sim.chunk_size,
+        "rounds": len(measured),
+        "clients_trained": int(clients),
+        "rounds_per_sec": round(len(measured) / span, 4),
+        "clients_per_sec": round(clients / span, 1),
+        "bytes_up_per_round": int(statistics.mean(
+            r["bytes_up_est"] for r in measured)),
+        "bytes_down_per_round": int(statistics.mean(
+            r["bytes_down_est"] for r in measured)),
+        "round_time_s_mean": round(statistics.mean(times), 4),
+        "round_time_s_warmup": round(history[0]["round_time_s"], 4),
+        "train_loss": float(measured[-1]["train_loss"]),
+        "param_count": int(sum(np.asarray(p).size for p in params)),
+        "bench_wall_s": round(wall, 1),
+    }
+
+
+def check_schema(path: str) -> int:
+    """Validate every row of a bench JSONL against ROW_SCHEMA (CI gate)."""
+    bad = 0
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    if not rows:
+        print(f"FAIL: {path} is empty", file=sys.stderr)
+        return 1
+    for i, row in enumerate(rows):
+        for key, typ in ROW_SCHEMA.items():
+            if key not in row:
+                print(f"FAIL: row {i} missing {key!r}", file=sys.stderr)
+                bad += 1
+            elif typ is float and not isinstance(row[key], (int, float)):
+                print(f"FAIL: row {i} {key!r} not numeric", file=sys.stderr)
+                bad += 1
+            elif typ is not float and not isinstance(row[key], typ):
+                print(f"FAIL: row {i} {key!r} not {typ.__name__}",
+                      file=sys.stderr)
+                bad += 1
+        if row.get("clients_trained", 0) <= 0:
+            print(f"FAIL: row {i} trained no clients", file=sys.stderr)
+            bad += 1
+    if not bad:
+        print(f"schema ok: {len(rows)} row(s) in {path}")
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cohorts", default="1000,10000,100000,1000000",
+                    help="comma-separated cohort sizes (devices == cohort)")
+    ap.add_argument("--rounds", type=int, default=2,
+                    help="measured rounds per point (after 1 warmup)")
+    ap.add_argument("--chunk", type=int, default=4096)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "results", "fleet_bench.jsonl"))
+    ap.add_argument("--check-schema", action="store_true",
+                    help="after the sweep, validate the output JSONL "
+                         "against ROW_SCHEMA and fail on any mismatch")
+    args = ap.parse_args(argv)
+
+    rows = []
+    for cohort in (int(c) for c in args.cohorts.split(",") if c):
+        row = run_point(cohort, args.rounds, args.chunk, args.seed)
+        rows.append(row)
+        print(json.dumps(row))
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"wrote {len(rows)} rows to {args.out}")
+    if args.check_schema:
+        return check_schema(args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -105,6 +105,15 @@ def run_bench(n_workers: int, scheme: str, rounds: int,
         # sample stands for every round.
         params_np = jax.tree.map(np.asarray, coord.server_state.params)
         full_len = wire_frame_length(params_np, {"round": 1, "down": "full"})
+        # Uplink frame length under the configured update scheme: also
+        # shape-only (compress_delta meta + leaf dtypes), so one zeros
+        # sample prices every update the workers send back.
+        from colearn_federated_learning_tpu.fed import compression
+        zeros = jax.tree.map(np.zeros_like, params_np)
+        wire_up, meta_up = compression.compress_delta(zeros,
+                                                      config.fed.compress)
+        uplink_len = wire_frame_length(
+            wire_up, {"round": 1, "op": "train", **meta_up})
 
         coord.run_round()                 # warmup: jit compile + delta base
         coord.round_timeout = round_timeout
@@ -149,6 +158,9 @@ def run_bench(n_workers: int, scheme: str, rounds: int,
         "full_frame_bytes": int(full_len),
         "downlink_frame_bytes": int(downlink_frame),
         "downlink_reduction_x": round(full_len / downlink_frame, 2),
+        "uplink_frame_bytes": int(uplink_len),
+        "uplink_bytes_per_round": int(uplink_len * statistics.mean(
+            r["sends"] for r in per_round)),
         "bytes_sent_per_round": int(statistics.mean(
             r["bytes_sent"] for r in per_round)),
         "bytes_saved_per_round": int(statistics.mean(

@@ -1,0 +1,374 @@
+"""fleetsim/: the chunked-vmap fleet simulator is the ENGINE at scale,
+not a parallel-but-different implementation — single-chunk rounds match
+`FederatedLearner.run_round` bit-for-bit (same PRNG keys, same FedAvg
+weighting, same server update), multi-chunk rounds to float tolerance,
+and a FaultPlan dropping k devices yields exactly the aggregate an
+independent per-client re-derivation produces without them (ISSUE 6
+acceptance).  Plus: population determinism/chunk-independence, traffic
+determinism/diurnal swing, wire-byte estimates, CLI + bench schema."""
+
+import dataclasses
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import fleetsim, telemetry
+from colearn_federated_learning_tpu.analysis import metric_catalog
+from colearn_federated_learning_tpu.faults.plan import FaultPlan, FaultSpec
+from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.utils import prng
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+def tiny_config(**fed_kw) -> ExperimentConfig:
+    fed = dict(strategy="fedavg", rounds=2, local_epochs=1, batch_size=32,
+               lr=0.05, momentum=0.9)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=10,
+                        partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32,
+                          depth=2),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="test", seed=0),
+    )
+
+
+def fleet_config(**fed_kw) -> ExperimentConfig:
+    fed = dict(strategy="fedavg", local_steps=2, batch_size=8, lr=0.05,
+               momentum=0.0)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32,
+                          depth=1),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="test", seed=0),
+    )
+
+
+def make_fleet(num_devices=256, cohort=64, chunk=32, **kw):
+    spec = fleetsim.PopulationSpec(num_devices=num_devices, feature_dim=16,
+                                   shard_capacity=16, min_examples=4)
+    population = fleetsim.DevicePopulation(spec)
+    traffic = fleetsim.TrafficModel(
+        fleetsim.TrafficSpec(base_rate=2000.0, diurnal_amplitude=0.0),
+        num_devices)
+    return fleetsim.FleetSim.from_population(
+        fleet_config(), population, traffic, cohort_size=cohort,
+        chunk_size=chunk, **kw)
+
+
+def max_param_diff(a, b) -> float:
+    la = jax.tree.leaves(jax.device_get(a))
+    lb = jax.tree.leaves(jax.device_get(b))
+    return max(float(np.max(np.abs(x - y))) for x, y in zip(la, lb))
+
+
+# ------------------------------------------------------------ population --
+def test_population_is_deterministic_and_chunking_independent():
+    pop = fleetsim.DevicePopulation(fleetsim.PopulationSpec(
+        num_devices=1000, feature_dim=8, shard_capacity=8, min_examples=2))
+    ids = np.array([3, 500, 999])
+    x1, y1, c1 = pop.materialize(ids)
+    # Same devices asked for one at a time, in another order: identical.
+    for k, i in enumerate([999, 3, 500]):
+        xi, yi, ci = pop.materialize(np.array([i]))
+        j = int(np.where(ids == i)[0][0])
+        np.testing.assert_array_equal(x1[j], xi[0])
+        np.testing.assert_array_equal(y1[j], yi[0])
+        assert c1[j] == ci[0]
+    # A fresh population with the same spec regenerates the same fleet.
+    pop2 = fleetsim.DevicePopulation(pop.spec)
+    x2, y2, c2 = pop2.materialize(ids)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+
+
+def test_population_counts_labels_and_padding():
+    spec = fleetsim.PopulationSpec(num_devices=500, feature_dim=8,
+                                   shard_capacity=8, min_examples=3,
+                                   label_skew=0.9)
+    pop = fleetsim.DevicePopulation(spec)
+    ids = np.arange(500)
+    x, y, counts = pop.materialize(ids)
+    assert x.shape == (500, 8, 8) and y.shape == (500, 8)
+    assert counts.min() >= 3 and counts.max() <= 8
+    # Non-IID: with 90% skew the home class dominates each valid shard.
+    home = pop.home_classes(ids)
+    valid = np.arange(8)[None, :] < counts[:, None]
+    match = ((y == home[:, None]) & valid).sum()
+    assert match / valid.sum() > 0.75
+    # Padding rows are zeroed so vmapped batches never read garbage.
+    assert np.all(x[~valid] == 0.0)
+
+
+def test_speed_classes_map_to_step_budgets():
+    spec = fleetsim.PopulationSpec(num_devices=10_000)
+    pop = fleetsim.DevicePopulation(spec)
+    ids = np.arange(10_000)
+    idx = pop.speed_class_index(ids)
+    fracs = np.bincount(idx, minlength=3) / ids.size
+    for k, cls in enumerate(spec.speed_classes):
+        assert abs(fracs[k] - cls.fraction) < 0.03
+    budgets = pop.step_budgets(ids, num_steps=8)
+    assert set(np.unique(budgets)) == {2, 4, 8}
+    np.testing.assert_array_equal(
+        budgets == 8, idx == 0)  # fast class runs the full budget
+
+
+# --------------------------------------------------------------- traffic --
+def test_traffic_is_deterministic_and_diurnal():
+    tm = fleetsim.TrafficModel(
+        fleetsim.TrafficSpec(base_rate=2.0, diurnal_amplitude=1.0,
+                             round_minutes=60.0), 5000)
+    m0 = tm.available_mask(3)
+    np.testing.assert_array_equal(m0, tm.available_mask(3))
+    # Amplitude 1.0 over a 24h cycle: availability must visibly swing.
+    fracs = [tm.expected_available(r) for r in range(24)]
+    assert max(fracs) > 1.5 * min(fracs)
+    # Different rounds draw different cohorts (fresh arrival draws).
+    assert not np.array_equal(tm.available_mask(3), tm.available_mask(4))
+
+
+def test_traffic_cohort_sampling_is_a_subset_without_replacement():
+    tm = fleetsim.TrafficModel(fleetsim.TrafficSpec(base_rate=20.0), 2000)
+    cohort = tm.sample_cohort(0, 64)
+    assert cohort.size == 64 and np.unique(cohort).size == 64
+    mask = tm.available_mask(0)
+    assert mask[cohort].all()
+    np.testing.assert_array_equal(cohort, tm.sample_cohort(0, 64))
+
+
+# ---------------------------------------------------------- engine parity --
+def test_single_chunk_round_matches_engine_exactly():
+    ln = FederatedLearner(tiny_config(cohort_size=4))
+    fs = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config(cohort_size=4)), chunk_size=8)
+    h_e = ln.fit(rounds=2)
+    h_f = fs.fit(2)
+    assert max_param_diff(ln.server_state.params,
+                          fs.server_state.params) <= 1e-7
+    for k in ("train_loss", "completed", "total_weight"):
+        assert h_f[-1][k] == pytest.approx(h_e[-1][k], abs=1e-6), k
+
+
+def test_multi_chunk_round_matches_engine_allclose():
+    ln = FederatedLearner(tiny_config())          # full 10-client cohort
+    fs = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config()), chunk_size=3)  # 4 padded chunks
+    h_e = ln.fit(rounds=2)
+    h_f = fs.fit(2)
+    # Chunked folding reorders float sums; identical semantics otherwise.
+    assert max_param_diff(ln.server_state.params,
+                          fs.server_state.params) <= 1e-5
+    assert h_f[-1]["total_weight"] == pytest.approx(h_e[-1]["total_weight"])
+    assert h_f[-1]["completed"] == pytest.approx(h_e[-1]["completed"])
+
+
+def test_engine_straggler_budgets_replicated():
+    kw = dict(straggler_prob=0.5, straggler_min_fraction=0.5, rounds=1)
+    h_e = FederatedLearner(tiny_config(**kw)).fit(rounds=1)
+    fs = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config(**kw)), chunk_size=4)
+    h_f = fs.fit(1)
+    assert h_f[0]["completed"] == pytest.approx(h_e[0]["completed"])
+    assert h_f[0]["total_weight"] == pytest.approx(h_e[0]["total_weight"])
+
+
+# ----------------------------------------------------------- fault parity --
+def manual_engine_round(ln, exclude=frozenset()):
+    """Independent per-client re-derivation of round 0 (no vmap, no
+    chunking): engine keys, engine weighting, engine server update,
+    minus the excluded devices — the acceptance-criterion reference."""
+    params = ln.server_state.params
+    r = jnp.asarray(0, jnp.int32)
+    budget = jnp.asarray(ln.num_steps, jnp.int32)
+    wsum = None
+    total_w = 0.0
+    for cid in range(ln.num_clients):
+        key = prng.client_round_key(ln.base_key,
+                                    jnp.asarray(cid, jnp.int32), r)
+        res = ln.local_update(params, jnp.asarray(ln.shards.x[cid]),
+                              jnp.asarray(ln.shards.y[cid]),
+                              jnp.asarray(ln.shards.counts[cid]),
+                              key, budget, None)
+        res = jax.device_get(res)
+        w = float(res.num_examples) * float(
+            bool(res.completed) and res.num_examples > 0
+            and cid not in exclude)
+        delta = jax.tree.map(lambda l: np.asarray(l, np.float64), res.delta)
+        scaled = jax.tree.map(lambda l: w * l, delta)
+        wsum = scaled if wsum is None else jax.tree.map(
+            np.add, wsum, scaled)
+        total_w += w
+    mean_delta = jax.tree.map(lambda l: l / total_w, wsum)
+    return jax.tree.map(
+        lambda p, d: np.asarray(p, np.float64)
+        + ln.config.fed.server_lr * d,
+        jax.device_get(params), mean_delta), total_w
+
+
+def test_fault_plan_drop_matches_engine_excluding_devices():
+    # ISSUE 6 acceptance: dropping k simulated devices via the FaultPlan
+    # == the engine aggregate without those devices.
+    dropped = {2, 5, 7}
+    plan = FaultPlan([FaultSpec(kind="drop_request", device_id=str(d),
+                                round=0, op="train") for d in dropped])
+    ref_ln = FederatedLearner(tiny_config())
+    want_params, want_w = manual_engine_round(ref_ln, exclude=dropped)
+
+    fs = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config()), chunk_size=4, fault_plan=plan)
+    rec = fs.run_round()
+    got = jax.device_get(fs.server_state.params)
+    diff = max(float(np.max(np.abs(np.asarray(a, np.float64) - b)))
+               for a, b in zip(jax.tree.leaves(got),
+                               jax.tree.leaves(want_params)))
+    assert diff <= 1e-5
+    assert rec["dropped"] == len(dropped)
+    assert rec["completed"] == ref_ln.num_clients - len(dropped)
+    assert rec["total_weight"] == pytest.approx(want_w)
+    assert plan.total_fired() == len(dropped)
+
+
+def test_fault_corrupt_discards_update_but_spends_uplink():
+    plan = FaultPlan([FaultSpec(kind="corrupt_payload", device_id="4",
+                                round=0, op="train")])
+    base = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config()), chunk_size=8)
+    rec0 = base.run_round()
+    fs = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config()), chunk_size=8, fault_plan=plan)
+    rec1 = fs.run_round()
+    assert rec1["corrupted"] == 1
+    assert rec1["completed"] == rec0["completed"] - 1
+    # The corrupted device still uploaded (CRC-reject happens AFTER the
+    # bytes are spent); a dropped device would not have.
+    assert rec1["bytes_up_est"] == rec0["bytes_up_est"]
+    assert rec1["clients_trained"] == rec0["clients_trained"]
+
+
+def test_fault_delay_cuts_step_budget_to_incomplete():
+    # Losing the whole round deadline -> zero budget -> straggler that
+    # never completes; it reports (uplink spent) but carries no weight.
+    plan = FaultPlan([FaultSpec(kind="delay", device_id="1", round=0,
+                                op="train", ms=1000.0)])
+    base = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config()), chunk_size=8)
+    rec0 = base.run_round()
+    fs = fleetsim.FleetSim.from_learner(
+        FederatedLearner(tiny_config()), chunk_size=8, fault_plan=plan,
+        round_deadline_ms=1000.0)
+    rec1 = fs.run_round()
+    assert rec1["straggled"] == 1
+    assert rec1["completed"] == rec0["completed"] - 1
+    assert rec1["bytes_up_est"] == rec0["bytes_up_est"]
+
+
+# ------------------------------------------------- population-mode rounds --
+def test_population_mode_trains_and_counts_bytes():
+    reg = telemetry.get_registry()
+    before_rounds = reg.counter("fleetsim.rounds_total").value
+    before_clients = reg.counter("fleetsim.clients_trained_total").value
+    fs = make_fleet(num_devices=256, cohort=64, chunk=32)
+    hist = fs.fit(4)
+    assert len(hist) == 4
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+    for rec in hist:
+        assert rec["cohort"] == 64
+        assert rec["bytes_down_est"] == 64 * fs.down_frame_bytes
+        assert rec["bytes_up_est"] == 64 * fs.up_frame_bytes
+        assert 0.0 < rec["available_fraction"] <= 1.0
+    assert reg.counter("fleetsim.rounds_total").value == before_rounds + 4
+    assert (reg.counter("fleetsim.clients_trained_total").value
+            == before_clients + 4 * 64)
+
+
+def test_chunk_size_does_not_change_population_mode_result():
+    a = make_fleet(num_devices=128, cohort=48, chunk=48)
+    b = make_fleet(num_devices=128, cohort=48, chunk=7)
+    a.fit(2)
+    b.fit(2)
+    assert max_param_diff(a.server_state.params,
+                          b.server_state.params) <= 1e-5
+
+
+def test_compressed_schemes_shrink_byte_estimates():
+    spec = fleetsim.PopulationSpec(num_devices=64, feature_dim=16,
+                                   shard_capacity=16, min_examples=4)
+    pop = fleetsim.DevicePopulation(spec)
+    tm = fleetsim.TrafficModel(
+        fleetsim.TrafficSpec(base_rate=2000.0, diurnal_amplitude=0.0), 64)
+    plain = fleetsim.FleetSim.from_population(
+        fleet_config(), pop, tm, cohort_size=16, chunk_size=16)
+    packed = fleetsim.FleetSim.from_population(
+        fleet_config(compress="int8", compress_down="topk"), pop, tm,
+        cohort_size=16, chunk_size=16)
+    assert packed.up_frame_bytes < plain.up_frame_bytes
+    assert packed.down_frame_bytes < plain.down_frame_bytes
+    assert plain.down_frame_bytes == plain.down_full_bytes
+
+
+def test_fleetsim_rejects_engine_only_configs():
+    fs_args = dict(num_devices=32, cohort=8, chunk=8)
+    for bad in (dict(strategy="scaffold"), dict(aggregator="median"),
+                dict(dp_clip=1.0), dict(secure_agg=True)):
+        spec = fleetsim.PopulationSpec(num_devices=32, feature_dim=8,
+                                       shard_capacity=8, min_examples=2)
+        with pytest.raises(NotImplementedError):
+            fleetsim.FleetSim.from_population(
+                fleet_config(**bad), fleetsim.DevicePopulation(spec),
+                fleetsim.TrafficModel(fleetsim.TrafficSpec(), 32),
+                cohort_size=fs_args["cohort"], chunk_size=fs_args["chunk"])
+
+
+def test_all_fleetsim_metrics_are_cataloged():
+    for name in ("fleetsim.rounds_total", "fleetsim.clients_trained_total",
+                 "fleetsim.bytes_up_est_total",
+                 "fleetsim.bytes_down_est_total", "fleetsim.devices",
+                 "fleetsim.chunk_size", "fleetsim.available_fraction",
+                 "fleetsim.round_time_s"):
+        assert metric_catalog.is_known(name), name
+
+
+# --------------------------------------------------------- CLI and bench --
+def test_cli_fleetsim_smoke(capsys):
+    from colearn_federated_learning_tpu.cli import main as cli_main
+
+    rc = cli_main(["fleetsim", "--devices", "128", "--cohort", "32",
+                   "--rounds", "2", "--chunk", "16", "--feature-dim", "8",
+                   "--capacity", "8", "--hidden-dim", "16", "--depth", "1",
+                   "--local-steps", "2", "--batch-size", "4"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["rounds"] == 2
+    assert summary["clients_trained"] == 64
+    assert summary["clients_per_sec"] > 0
+    assert summary["bytes_up_per_round"] > 0
+
+
+def test_bench_fleet_writes_schema_valid_jsonl(tmp_path):
+    out = tmp_path / "fleet_bench.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "scripts/bench_fleet.py", "--cohorts", "32",
+         "--rounds", "1", "--chunk", "16", "--check-schema",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=240,
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parents[1]),
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert rows and rows[0]["cohort"] == 32
+    assert rows[0]["clients_per_sec"] > 0
+    assert rows[0]["bytes_up_per_round"] > 0

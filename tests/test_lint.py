@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL008), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL009), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -385,6 +385,72 @@ def test_cl008_suppression(tmp_path):
             with open(path, "wb") as f:  # colearn: noqa(CL008)
                 f.write(blob)
     """, relpath="pkg/fed/offline.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL009 ----
+def test_cl009_flags_per_device_loop_in_hot_path(tmp_path):
+    res = run_lint(tmp_path, """
+        def run_round(cohort_ids, train_one):
+            out = []
+            for device_id in cohort_ids:  # colearn: hot
+                out.append(train_one(device_id))
+            return out
+    """, relpath="pkg/fleetsim/mod.py")
+    assert rule_ids(res) == ["CL009"]
+    assert res.exit_code == 1
+
+
+def test_cl009_flags_local_update_call_per_iteration(tmp_path):
+    res = run_lint(tmp_path, """
+        def run_round(chunks, local_update, params):
+            acc = None
+            for chunk in chunks:  # colearn: hot
+                acc = local_update(params, chunk)
+            return acc
+    """, relpath="pkg/fleetsim/sim.py")
+    assert rule_ids(res) == ["CL009"]
+
+
+def test_cl009_allows_chunk_loop(tmp_path):
+    # The blessed shape: loop over CHUNK OFFSETS, one jitted vmapped
+    # dispatch per chunk (fleetsim/sim.FleetSim.run_round).
+    res = run_lint(tmp_path, """
+        def run_round(n, chunk, chunk_fn, fold, acc):
+            for lo in range(0, n, chunk):  # colearn: hot
+                acc = fold(acc, chunk_fn(lo))
+            return acc
+    """, relpath="pkg/fleetsim/sim.py")
+    assert res.findings == []
+
+
+def test_cl009_ignores_unmarked_and_non_fleetsim_loops(tmp_path):
+    src = """
+        def setup(device_ids, probe):
+            for device_id in device_ids:
+                probe(device_id)
+
+        def elsewhere(client_ids, send):
+            for client_id in client_ids:  # colearn: hot
+                send(client_id)
+    """
+    # Unmarked fleetsim loop: cold paths may iterate per device.
+    res = run_lint(tmp_path, src.split("def elsewhere")[0],
+                   relpath="pkg/fleetsim/population.py")
+    assert res.findings == []
+    # Marked per-client loop OUTSIDE fleetsim/: not CL009's business
+    # (the comm fan-out has its own rules).
+    res = run_lint(tmp_path, "def elsewhere" + src.split("def elsewhere")[1],
+                   relpath="pkg/comm/mod.py")
+    assert res.findings == []
+
+
+def test_cl009_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def debug_round(cohort_ids, train_one):
+            for device_id in cohort_ids:  # colearn: hot  # colearn: noqa(CL009)
+                train_one(device_id)
+    """, relpath="pkg/fleetsim/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
 
