@@ -64,6 +64,12 @@ COUNTERS = (
     "fleetsim.clients_trained_total",
     "fleetsim.bytes_up_est_total",     # wire-codec frame estimate, uplink
     "fleetsim.bytes_down_est_total",   # wire-codec frame estimate, downlink
+    # runtime observability plane (telemetry/runtime.py, telemetry/flight.py)
+    "telemetry.compile_total",       # labeled {fn=<name>}: distinct XLA sigs
+    "telemetry.recompile_total",     # labeled {fn,reason=shape|dtype|structure}
+    "flight.dumps_total",            # flight-recorder dump writes
+    "export.scrapes_total",          # /metrics + /snapshot.json hits
+    "export.events_written_total",   # JSONL event-stream lines
 )
 
 # Gauges -------------------------------------------------------------------
@@ -73,6 +79,10 @@ GAUGES = (
     "fleetsim.devices",
     "fleetsim.chunk_size",
     "fleetsim.available_fraction",
+    # live HBM sampling (telemetry/runtime.py; empty on CPU backends)
+    "runtime.hbm_bytes_in_use",
+    "runtime.hbm_bytes_limit",
+    "runtime.hbm_peak_bytes_in_use",
 )
 
 # Histograms ---------------------------------------------------------------

@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL009).
+"""The colearn rule set (CL001–CL010).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -273,6 +273,17 @@ class MetricNameDrift(Rule):
                         ctx, node,
                         f"dynamic metric name with prefix {prefix!r} matches "
                         "no `family.*` wildcard in the catalog")
+            elif arg is not None:
+                # A plain-variable name used to slip through unvalidated —
+                # the exact hole a typo'd series hides in.  Loops over a
+                # catalog-declared tuple (metric_catalog.SOAK_DELTA_COUNTERS)
+                # carry a justified noqa.
+                yield self.finding(
+                    ctx, node,
+                    "non-literal metric name: the catalog cannot validate "
+                    "it — inline the literal, use an f-string with a "
+                    "`family.*` prefix, or iterate a catalog-declared "
+                    "tuple with a justified noqa")
 
 
 # ----------------------------------------------------------------- CL006 --
@@ -507,3 +518,59 @@ class PerClientLoopInFleetHotPath(Rule):
                         f"{tail}() called once per iteration of a "
                         "`# colearn: hot` loop; vmap it over the chunk "
                         "instead")
+
+
+# ----------------------------------------------------------------- CL010 --
+@register
+class NoPrintInLibrary(Rule):
+    """Library code has two sanctioned output planes — the metrics
+    registry and the JSONL event/record streams; a stray ``print()`` to
+    stdout interleaves with the machine-readable stdout contract the CLI
+    maintains (round records, bench JSON) and corrupts downstream
+    parsers.  CLI entry surfaces own stdout and are exempt; stderr
+    diagnostics and ``__main__``-guarded debug mains are allowed."""
+
+    id = "CL010"
+    title = "print() to stdout in library code"
+    hint = ("route through the metrics/event plane, or print to stderr "
+            "(`print(..., file=sys.stderr)`); CLI entry modules are "
+            "exempt by name")
+
+    # Modules whose contract IS stdout (subcommand surface, bench JSON).
+    _EXEMPT_FILES = {"cli.py", "bench.py"}
+
+    @staticmethod
+    def _is_main_guard(test: ast.AST) -> bool:
+        return (isinstance(test, ast.Compare)
+                and isinstance(test.left, ast.Name)
+                and test.left.id == "__name__"
+                and any(isinstance(c, ast.Constant)
+                        and c.value == "__main__"
+                        for c in test.comparators))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.parts and ctx.parts[-1] in self._EXEMPT_FILES:
+            return
+        if ctx.in_dir("scripts"):
+            return
+        guarded: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.If) and self._is_main_guard(node.test):
+                for inner in ast.walk(node):
+                    guarded.add(id(inner))
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                continue
+            if id(node) in guarded:
+                continue
+            file_kw = next((kw.value for kw in node.keywords
+                            if kw.arg == "file"), None)
+            if file_kw is not None and dotted_name(file_kw) != "sys.stdout":
+                continue              # explicit non-stdout sink
+            yield self.finding(
+                ctx, node,
+                "print() to stdout in library code interleaves with the "
+                "machine-readable stdout contract; use the metrics/event "
+                "plane or stderr")

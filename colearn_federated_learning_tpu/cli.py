@@ -144,6 +144,68 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="override the fault plan's seed")
 
 
+def _add_observability_flags(p: argparse.ArgumentParser) -> None:
+    """Opt-in runtime observability plane for long-running processes
+    (worker/coordinate): crash flight recorder, Prometheus endpoint,
+    JSONL event stream.  All off by default — zero threads, zero files."""
+    p.add_argument("--flight-dir", default=None,
+                   help="crash flight recorder: heartbeat-rewrite a "
+                        "bounded black box (flight_<pid>.json) here; "
+                        "survives SIGKILL up to one heartbeat of "
+                        "staleness (`colearn postmortem` reads these)")
+    p.add_argument("--flight-heartbeat", type=float, default=5.0,
+                   help="flight-recorder rewrite period in seconds")
+    p.add_argument("--flight-watchdog", type=float, default=None,
+                   help="declare a stall (and dump) after this many "
+                        "seconds without round progress")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve /metrics (Prometheus text) and "
+                        "/snapshot.json on 127.0.0.1:<port>; 0 binds an "
+                        "ephemeral port announced as a metrics_port "
+                        "event on stderr")
+    p.add_argument("--events-file", default=None,
+                   help="append lifecycle + round events as JSONL here "
+                        "(push half of the export plane)")
+
+
+def _setup_observability(args: argparse.Namespace, role: str,
+                         tracers: tuple = ()) -> tuple:
+    """Install whichever observability features the flags opted into.
+    Returns ``(exporter, events, recorder)`` — each None when off."""
+    from colearn_federated_learning_tpu import telemetry
+
+    recorder = exporter = events = None
+    if args.flight_dir:
+        recorder = telemetry.install_flight_recorder(
+            args.flight_dir, role=role,
+            heartbeat_s=args.flight_heartbeat,
+            watchdog_s=args.flight_watchdog)
+        for tr in tracers:
+            recorder.attach_tracer(tr)
+    if args.metrics_port is not None:
+        exporter = telemetry.MetricsExporter(port=args.metrics_port).start()
+        print(json.dumps({"event": "metrics_port", "port": exporter.port}),
+              file=sys.stderr)
+    if args.events_file:
+        events = telemetry.EventLog(args.events_file)
+        events.emit("start", role=role)
+    return exporter, events, recorder
+
+
+def _obs_round_hook(events, recorder):
+    """Per-round-record side channel: event-stream line + flight-ring
+    entry + watchdog progress mark.  Cheap no-op when both are off."""
+    def hook(rec: dict) -> None:
+        if events is not None:
+            events.emit("round", **{
+                k: v for k, v in rec.items()
+                if isinstance(v, (int, float, str, bool))})
+        if recorder is not None:
+            recorder.record("round", round=rec.get("round"))
+            recorder.mark_progress()
+    return hook
+
+
 _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "batch_size", "lr", "lr_schedule", "warmup_rounds",
              "lr_min_fraction", "momentum", "local_optimizer", "strategy",
@@ -355,6 +417,7 @@ def cmd_worker(args: argparse.Namespace) -> int:
         print("worker requires --client-id", file=sys.stderr)
         return 2
     _install_fault_plan(config)
+    _setup_observability(args, role=f"worker{args.client_id}")
     mud = None
     if args.mud_profile:
         with open(args.mud_profile) as f:
@@ -405,6 +468,9 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
 
     config = config_from_args(args)
     _install_fault_plan(config)
+    _exporter, events, recorder = _setup_observability(
+        args, role="coordinator")
+    obs = _obs_round_hook(events, recorder)
     mud_policy = None
     if args.mud_require_profile or args.mud_allowed_types:
         from colearn_federated_learning_tpu.comm.mud import MudPolicy
@@ -431,6 +497,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             # concurrently and print()'s separate newline write could
             # interleave lines mid-JSON.
             sys.stderr.write(json.dumps({"type": t, **rec}) + "\n")
+            obs({"type": t, **rec})
 
         try:
             hists = fed.run(
@@ -460,6 +527,8 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             want_evaluator=not args.no_evaluator,
             mud_policy=mud_policy,
         )
+        if recorder is not None:
+            recorder.attach_tracer(coord.tracer)
         with coord:
             if args.resume:
                 _coordinator_resume(coord)
@@ -468,7 +537,8 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             remaining = max(0, config.fed.rounds - len(coord.history))
             hist = coord.fit(
                 aggregations=remaining,
-                log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+                log_fn=lambda rec: (print(json.dumps(rec), file=sys.stderr),
+                                    obs(rec))[0],
                 elastic=args.elastic,
             )
             _write_coordinator_trace(config, coord)
@@ -478,13 +548,16 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
                                  round_timeout=args.round_timeout,
                                  want_evaluator=not args.no_evaluator,
                                  mud_policy=mud_policy)
+    if recorder is not None:
+        recorder.attach_tracer(coord.tracer)
     with coord:
         if args.resume:
             _coordinator_resume(coord)
         coord.enroll(min_devices=args.min_devices,
                      timeout=args.enroll_timeout)
-        hist = coord.fit(log_fn=lambda rec: print(json.dumps(rec),
-                                                  file=sys.stderr),
+        hist = coord.fit(log_fn=lambda rec: (print(json.dumps(rec),
+                                                   file=sys.stderr),
+                                             obs(rec))[0],
                          elastic=args.elastic)
         if args.per_client_eval:
             print(json.dumps(coord.evaluate_per_client()), file=sys.stderr)
@@ -519,7 +592,10 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         ok = (summary["exit_code"] == 0
               and summary["rounds_run"] == args.rounds
               and summary["weighted_acc"] is not None
-              and (summary["rounds_resumed"] >= 1 or not need_resume))
+              and (summary["rounds_resumed"] >= 1 or not need_resume)
+              # Every SIGKILLed process must have left a parseable
+              # flight dump behind (heartbeat survivability).
+              and not summary["flight_missing"])
         return 0 if ok else 1
     import jax
 
@@ -597,9 +673,18 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
     sim = fleetsim.FleetSim.from_population(
         config, population, traffic, cohort_size=args.cohort,
         chunk_size=args.chunk, fault_plan=plan)
+    if args.trace_dir:
+        sim.tracer.enabled = True
     history = sim.fit(
         args.rounds,
         log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr))
+    if args.trace_dir:
+        from colearn_federated_learning_tpu import telemetry
+
+        path = telemetry.write_tracer(
+            args.trace_dir, "fleetsim", sim.tracer,
+            metrics=telemetry.get_registry().snapshot())
+        print(f"trace written to {path}", file=sys.stderr)
     wall = sum(r["round_time_s"] for r in history) or 1e-9
     clients = sum(r["clients_trained"] for r in history)
     summary = {
@@ -618,6 +703,9 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
         "straggled": sum(r["straggled"] for r in history),
         "corrupted": sum(r["corrupted"] for r in history),
         "train_loss": history[-1]["train_loss"],
+        # One entry per jitted executable; "chunk" staying at 1 across a
+        # whole sweep is the pad-to-fixed-width invariant, machine-checked.
+        "compiles": sim.compile_counts,
     }
     print(json.dumps(summary))
     return 0 if history and clients > 0 else 1
@@ -678,6 +766,92 @@ def cmd_trace_summary(args: argparse.Namespace) -> int:
         return 2
     print(telemetry.summarize_trace(doc, root=args.root))
     return 0
+
+
+def cmd_postmortem(args: argparse.Namespace) -> int:
+    """Merge crash flight dumps with the round WAL into one causal report:
+    who died, of what, at which round, and which rounds were in flight
+    (logged but not yet durable in a checkpoint)."""
+    import os
+
+    from colearn_federated_learning_tpu import telemetry
+
+    dumps = telemetry.load_flight_dumps(args.flight_dir)
+    wal_entries = None
+    if args.wal_dir:
+        from colearn_federated_learning_tpu.ckpt.wal import RoundWal
+
+        wal_dir = args.wal_dir
+        if os.path.isfile(wal_dir):           # accept the file path too
+            wal_dir = os.path.dirname(wal_dir) or "."
+        wal_entries = RoundWal(wal_dir).load()
+    report = telemetry.postmortem_report(
+        dumps, wal_entries=wal_entries,
+        checkpoint_step=args.checkpoint_step)
+    if args.format == "json":
+        print(json.dumps(report))
+    else:
+        print(telemetry.render_postmortem(report))
+    return 0 if dumps else 1
+
+
+def cmd_top(args: argparse.Namespace) -> int:
+    """Terminal dashboard over a live /snapshot.json endpoint: round
+    rate, cohort health, fault counters, compile churn, HBM."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    from colearn_federated_learning_tpu.telemetry import runtime
+
+    url = args.url or f"http://127.0.0.1:{args.port}/snapshot.json"
+    prev = None
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                snap = json.loads(resp.read().decode("utf-8"))
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            print(f"colearn top: cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+        body = runtime.render_top(
+            snap, prev=prev,
+            interval_s=args.interval if prev is not None else 0.0)
+        if args.once:
+            print(body)
+            return 0
+        # Clear + home instead of curses: works in any terminal and in
+        # script(1) captures.
+        sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+        sys.stdout.flush()
+        prev = snap
+        time.sleep(args.interval)
+
+
+def cmd_sentinel(args: argparse.Namespace) -> int:
+    """Evaluate the [tool.colearn.slo] rules against committed results/
+    benchmark JSONL — exit non-zero on any violation (the CI perf gate)."""
+    import os
+
+    from colearn_federated_learning_tpu.analysis import sentinel
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    if args.root:
+        root = os.path.abspath(args.root)
+    else:
+        root = next(
+            (c for c in (os.getcwd(), os.path.dirname(pkg_dir))
+             if os.path.exists(os.path.join(c, "pyproject.toml"))),
+            os.getcwd())
+    try:
+        verdict = sentinel.evaluate_slo(root)
+    except ValueError as e:
+        print(f"colearn sentinel: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(verdict))
+    else:
+        print(sentinel.render_verdict(verdict))
+    return 0 if verdict["ok"] else 1
 
 
 def cmd_configs(_args: argparse.Namespace) -> int:
@@ -766,6 +940,7 @@ def main(argv: list[str] | None = None) -> int:
     p_worker.add_argument("--mud-profile", default=None,
                           help="path to this device's RFC 8520 MUD JSON, "
                                "announced on enrollment (comm/mud.py)")
+    _add_observability_flags(p_worker)
     p_worker.set_defaults(fn=cmd_worker)
 
     p_coord = sub.add_parser("coordinate",
@@ -804,6 +979,7 @@ def main(argv: list[str] | None = None) -> int:
                               "aggregation (FedBuff-style): apply the "
                               "staleness-weighted mean every N updates "
                               "instead of running synchronous rounds")
+    _add_observability_flags(p_coord)
     p_coord.set_defaults(fn=cmd_coordinate)
 
     p_chaos = sub.add_parser("chaos",
@@ -881,11 +1057,15 @@ def main(argv: list[str] | None = None) -> int:
                               "keys drive per-simulated-device drop/"
                               "straggle/corrupt")
     p_fleet.add_argument("--fault-seed", type=int, default=None)
+    p_fleet.add_argument("--trace-dir", default=None,
+                         help="write the sweep's span trace (fleet_round/"
+                              "train_chunks/train_chunk) as a Chrome-trace "
+                              "JSON here; read with `colearn trace-summary`")
     p_fleet.set_defaults(fn=cmd_fleetsim)
 
     p_lint = sub.add_parser("lint",
                             help="run the AST invariant checks "
-                                 "(CL001-CL009; analysis/) — fast, "
+                                 "(CL001-CL010; analysis/) — fast, "
                                  "CPU-only, no jax init")
     p_lint.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the installed "
@@ -914,6 +1094,47 @@ def main(argv: list[str] | None = None) -> int:
     p_trace.add_argument("--root", default="round",
                          help="span name used as the per-round denominator")
     p_trace.set_defaults(fn=cmd_trace_summary)
+
+    p_pm = sub.add_parser("postmortem",
+                          help="merge crash flight dumps (--flight-dir) "
+                               "with the round WAL into a who-died-where "
+                               "report")
+    p_pm.add_argument("flight_dir",
+                      help="directory holding flight_<pid>.json dumps "
+                           "(searched recursively)")
+    p_pm.add_argument("--wal-dir", default=None,
+                      help="checkpoint dir holding round_wal.jsonl (or "
+                           "the file itself) to reconcile rounds against")
+    p_pm.add_argument("--checkpoint-step", type=int, default=None,
+                      help="latest durable checkpoint round; WAL entries "
+                           "past it count as in flight, not committed")
+    p_pm.add_argument("--format", choices=["text", "json"], default="text")
+    p_pm.set_defaults(fn=cmd_postmortem)
+
+    p_top = sub.add_parser("top",
+                           help="live terminal view of a --metrics-port "
+                                "process: round rate, cohort health, "
+                                "faults, compiles, HBM")
+    p_top.add_argument("--port", type=int, default=9100,
+                       help="metrics port of the process to watch")
+    p_top.add_argument("--url", default=None,
+                       help="full /snapshot.json URL (overrides --port)")
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       help="refresh period in seconds")
+    p_top.add_argument("--once", action="store_true",
+                       help="print one snapshot and exit (no screen clear)")
+    p_top.set_defaults(fn=cmd_top)
+
+    p_slo = sub.add_parser("sentinel",
+                           help="evaluate [tool.colearn.slo] rules against "
+                                "results/*.jsonl; non-zero exit on any "
+                                "regression (the CI perf gate)")
+    p_slo.add_argument("--root", default=None,
+                       help="repo root holding pyproject.toml and the "
+                            "rule-referenced result files (default: cwd, "
+                            "else the package parent)")
+    p_slo.add_argument("--format", choices=["text", "json"], default="text")
+    p_slo.set_defaults(fn=cmd_sentinel)
 
     p_bench = sub.add_parser("bench", help="run the headline benchmark")
     p_bench.add_argument("--rounds", type=int, default=20)

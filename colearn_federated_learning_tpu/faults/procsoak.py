@@ -201,8 +201,10 @@ def run_proc_soak(
     ``skipped_rounds``, ``evicted``, ``per_client_acc``) and adds the
     process-level ledger: ``kills`` delivered, ``rounds_resumed`` (count
     of successful ``--resume`` recoveries, reported by the coordinator's
-    resume event line), ``coordinator_incarnations`` and the final
-    ``exit_code``."""
+    resume event line), ``coordinator_incarnations``, the final
+    ``exit_code``, and the flight ledger — ``flight_dumps`` (parseable
+    black boxes found) and ``flight_missing`` (SIGKILLed pids that left
+    no parseable dump; must be empty)."""
     if rounds < 1:
         raise ValueError(f"rounds must be >= 1, got {rounds}")
     kills = list(kills or [])
@@ -215,6 +217,7 @@ def run_proc_soak(
     workdir = workdir or tempfile.mkdtemp(prefix="colearn_mpsoak_")
     os.makedirs(workdir, exist_ok=True)
     ckpt_dir = os.path.join(workdir, "ckpt")
+    flight_dir = os.path.join(workdir, "flight")
 
     env = dict(os.environ)
     env["PYTHONUNBUFFERED"] = "1"      # round records must stream, not batch
@@ -238,11 +241,16 @@ def run_proc_soak(
     try:
         watchdog.start()
         host, port = fleet.start_broker(timeout=30.0)
-        worker_cfg = _config_flags(rounds, n_workers, seed)
+        # Every process flies with the black box on a fast heartbeat: a
+        # SIGKILL is uncatchable, so the per-kill dump the summary
+        # asserts below IS the victim's last heartbeat rewrite.
+        flight_flags = ["--flight-dir", flight_dir,
+                        "--flight-heartbeat", "0.5"]
+        worker_cfg = _config_flags(rounds, n_workers, seed) + flight_flags
         for i in range(n_workers):
             fleet.start_worker(i, worker_cfg, host, port)
         coord_cfg = _config_flags(rounds, n_workers, seed,
-                                  checkpoint_dir=ckpt_dir)
+                                  checkpoint_dir=ckpt_dir) + flight_flags
 
         def launch(resume: bool) -> subprocess.Popen:
             return fleet.start_coordinator(
@@ -281,19 +289,22 @@ def run_proc_soak(
                 log_fn(doc)
             while pending and pending[0].after_round <= r:
                 spec = pending.pop(0)
-                delivered.append({**dataclasses.asdict(spec),
-                                  "fired_after_round": r})
+                kill_rec = {**dataclasses.asdict(spec),
+                            "fired_after_round": r}
                 if spec.target == "coordinator":
+                    kill_rec["pid"] = coord.pid
                     coord.send_signal(signal.SIGKILL)
                     restart_pending = True
                 else:
                     wid = int(spec.target.split(":", 1)[1])
                     victim = fleet.workers.get(wid)
                     if victim is not None and victim.poll() is None:
+                        kill_rec["pid"] = victim.pid
                         victim.send_signal(signal.SIGKILL)
                         victim.wait()
                     if spec.restart:
                         fleet.start_worker(wid, worker_cfg, host, port)
+                delivered.append(kill_rec)
     finally:
         watchdog.cancel()
         fleet.close()
@@ -302,6 +313,17 @@ def run_proc_soak(
         raise RuntimeError(
             f"coordinator never exited cleanly within {timeout_s}s "
             f"(records for rounds {sorted(records)})")
+
+    # Flight-dump ledger: every SIGKILLed pid must have left a parseable
+    # black box (the acceptance criterion the flight recorder exists
+    # for).  A dump that exists but does not parse counts as missing —
+    # the atomic-write contract says a dump either parses or is absent.
+    from colearn_federated_learning_tpu.telemetry import flight as _flight
+
+    dumps = _flight.load_flight_dumps(flight_dir)
+    dumped_pids = {d.get("pid") for d in dumps if "error" not in d}
+    flight_missing = sorted({k["pid"] for k in delivered if "pid" in k}
+                            - dumped_pids)
 
     recs = [records[r] for r in sorted(records)]
     return {
@@ -319,6 +341,8 @@ def run_proc_soak(
         "rounds_resumed": resumed,
         "coordinator_incarnations": incarnations,
         "kills": delivered,
+        "flight_dumps": len(dumped_pids),
+        "flight_missing": flight_missing,
         "events": events,
         "exit_code": rc,
         "workdir": workdir,

@@ -429,8 +429,17 @@ class FederatedLearner:
             self.cohort_size if mesh is None else self.cohort_per_device
         )
         self.base_key = prng.experiment_key(c.run.seed)
-        self._round_fn = programs.build_round_fn(self)
+        # CompileTracker fingerprints every call's abstract signature: the
+        # expected first compile lands in telemetry.compile_total, any
+        # LATER new signature is a recompile with an attributed reason
+        # (telemetry.recompile_total{fn,reason}) — a coordinator silently
+        # recompiling every round becomes a visible counter + round-record
+        # field.  Attribute access (.lower, for the perf script's AOT
+        # path) passes through to the jitted fn.
+        self._round_fn = telemetry.CompileTracker(
+            programs.build_round_fn(self), name="engine.round")
         self._eval_fn = self._build_eval_fn()
+        self._flops_per_round: Optional[float] = None
         # Recording stays off until fit() opens a trace window (trace_dir);
         # span() still yields timed spans either way, so run_round's phase
         # durations are always available to the metrics JSONL.
@@ -605,6 +614,10 @@ class FederatedLearner:
         out["phase_sync_s"] = sync_sp.duration_s
         if sample_sp is not None:
             out["phase_cohort_sample_s"] = sample_sp.duration_s
+        # Key present only when something went wrong — a healthy run's
+        # records stay byte-identical (tested layout contract).
+        if self._round_fn.recompiles:
+            out["recompiles"] = self._round_fn.recompiles
         telemetry.get_registry().counter("engine.rounds_total").inc()
         if self.accountant is not None:
             self.accountant.step()
@@ -612,6 +625,28 @@ class FederatedLearner:
             out["dp_delta"] = self.accountant.delta
         self.history.append(out)
         return out
+
+    def round_cost_analysis(self) -> dict:
+        """XLA's own cost analysis of the compiled round program for the
+        CURRENT operand shapes (AOT lower+compile, cached per signature
+        by the tracker).  ``flops_per_round`` applies the local-SGD trip
+        count: XLA counts a while/scan BODY ONCE (trip counts are not
+        modeled) and the local-SGD scan holds essentially all the FLOPs —
+        the reported count is identical for local_steps=1 and
+        local_steps=8 — so the per-round figure scales by num_steps."""
+        if self.scaffold:
+            sel, rows = self._host_sample_cohort(0)
+            c_cohort = jax.tree.map(lambda l: l[rows], self.client_c)
+            sel_dev = jnp.asarray(sel)
+        else:
+            sel_dev, c_cohort = None, None
+        cost = self._round_fn.cost_analysis(
+            self.server_state, self.base_key, jnp.asarray(0, jnp.int32),
+            *self._device_data, sel_dev, c_cohort, self._dp_clip,
+        )
+        if cost.get("flops"):
+            cost["flops_per_round"] = cost["flops"] * self.num_steps
+        return cost
 
     def finalize_history(self) -> list[dict]:
         """Materialize any deferred (``sync=False``) round metrics to floats
@@ -829,6 +864,12 @@ class FederatedLearner:
         want_ckpt = bool(run.checkpoint_dir)
         last_round = len(self.history) + rounds - 1  # fit() may be called again
         telem = telemetry.RoundTelemetry(run, self.tracer)
+        # FLOPs capture is opt-in with the trace window (the AOT compile
+        # behind cost_analysis does not share the jit cache, so it is a
+        # real one-time cost) and cached across fit() calls.
+        if telem.tracing and self._flops_per_round is None:
+            self._flops_per_round = self.round_cost_analysis().get(
+                "flops_per_round")
         try:
             for _ in range(rounds):
                 t0 = time.perf_counter()
@@ -844,6 +885,16 @@ class FederatedLearner:
                         jax.block_until_ready(self.server_state.params)
                     telem.after_round(rec["round"])
                     rec["round_time_s"] = time.perf_counter() - t0
+                    # Both keys appear only when their source exists —
+                    # memory_stats() is empty on CPU, flops capture is
+                    # trace-window opt-in — so default-run records stay
+                    # byte-identical (tested layout contract).
+                    stats = telemetry.sample_device_memory()
+                    if stats.get("bytes_in_use"):
+                        rec["hbm_used_gb"] = round(
+                            stats["bytes_in_use"] / 2**30, 3)
+                    if self._flops_per_round:
+                        rec["flops_per_round"] = self._flops_per_round
                     if (rec["round"] % eval_every == 0
                             or rec["round"] == last_round):
                         with self.tracer.span("evaluate") as ev_sp:

@@ -10,6 +10,7 @@ from __future__ import annotations
 import pathlib
 import shutil
 import subprocess
+import sys
 
 _ROOT = pathlib.Path(__file__).resolve().parent
 SOURCES = [_ROOT / "src" / "gather.cpp", _ROOT / "src" / "topk.cpp"]
@@ -42,7 +43,7 @@ def build(verbose: bool = False) -> pathlib.Path:
     cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
            *map(str, SOURCES), "-o", str(LIB)]
     if verbose:
-        print(" ".join(cmd))
+        print(" ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True, capture_output=not verbose)
     return LIB
 

@@ -9,7 +9,12 @@ Public surface:
 - :mod:`.export` — Chrome-trace/Perfetto JSON writer/loader and the
   ``colearn trace-summary`` text breakdown;
 - :class:`RoundTelemetry` — the per-round lifecycle driver shared by the
-  span tracer window and the jax profiler window.
+  span tracer window and the jax profiler window;
+- :mod:`.runtime` — XLA introspection (:class:`CompileTracker` recompile
+  detection, AOT cost analysis, HBM gauges) and live export (Prometheus
+  endpoint, JSONL event stream, ``colearn top`` renderer);
+- :mod:`.flight` — crash flight recorder (heartbeat ring-buffer dumps,
+  ``colearn postmortem`` merge with the round WAL).
 """
 
 from colearn_federated_learning_tpu.telemetry.tracer import (  # noqa: F401
@@ -37,4 +42,20 @@ from colearn_federated_learning_tpu.telemetry.export import (  # noqa: F401
 )
 from colearn_federated_learning_tpu.telemetry.lifecycle import (  # noqa: F401
     RoundTelemetry,
+)
+from colearn_federated_learning_tpu.telemetry.runtime import (  # noqa: F401
+    CompileTracker,
+    EventLog,
+    MetricsExporter,
+    compiled_cost,
+    prometheus_text,
+    sample_device_memory,
+)
+from colearn_federated_learning_tpu.telemetry.flight import (  # noqa: F401
+    FlightRecorder,
+    get_flight_recorder,
+    install_flight_recorder,
+    load_flight_dumps,
+    postmortem_report,
+    render_postmortem,
 )

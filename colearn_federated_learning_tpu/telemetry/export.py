@@ -129,6 +129,13 @@ def summarize_trace(doc: dict, root: str = "round") -> str:
     column is the total time under ``root`` spans when any exist (so
     phase percentages read as "share of round wall time"), otherwise the
     overall traced extent.
+
+    Fleetsim sweep traces are understood natively: with the default root
+    and no ``round`` spans present, the root falls back to
+    ``fleet_round``, and the per-chunk ``train_chunk`` children get a
+    dispatch-rate line (chunks/s and clients/s at the chunk size carried
+    in the ``train_chunks`` span attrs) instead of rendering as one
+    opaque block.
     """
     spans = trace_spans(doc)
     if not spans:
@@ -136,6 +143,9 @@ def summarize_trace(doc: dict, root: str = "round") -> str:
     by_name: dict[str, list[Span]] = {}
     for sp in spans:
         by_name.setdefault(sp.name, []).append(sp)
+    if root == "round" and "round" not in by_name and (
+            "fleet_round" in by_name):
+        root = "fleet_round"
     roots = by_name.get(root, [])
     if roots:
         denom = sum(sp.duration_s for sp in roots)
@@ -177,6 +187,23 @@ def summarize_trace(doc: dict, root: str = "round") -> str:
             f"phase coverage of {root} time: "
             f"{100.0 * min(1.0, child_t / denom):.1f}%"
         )
+    # Fleetsim chunked-vmap sweep: dispatch-rate stats for the chunk loop.
+    chunks = by_name.get("train_chunk", [])
+    if chunks:
+        chunk_t = max(sum(sp.duration_s for sp in chunks), 1e-12)
+        # Total clients through the loop: the wrapper span carries the
+        # per-round cohort in its attrs.
+        cohort = sum(int(sp.attrs.get("cohort") or 0)
+                     for sp in by_name.get("train_chunks", []))
+        lines.append("")
+        lines.append(
+            f"fleetsim sweep: {len(chunks)} chunk dispatch(es), "
+            f"{len(chunks) / chunk_t:.1f} chunks/s "
+            f"(mean {chunk_t / len(chunks) * 1e3:.3f} ms/chunk)")
+        if cohort:
+            lines.append(
+                f"fleetsim sweep: {cohort} client(s) at "
+                f"{cohort / chunk_t:.0f} clients/s through the chunk loop")
     metrics = doc.get("otherData", {}).get("metrics")
     if metrics:
         lines.append("")

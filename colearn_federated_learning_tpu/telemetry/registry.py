@@ -199,6 +199,23 @@ class MetricsRegistry:
                 out[name] = inst.summary()
         return out
 
+    def typed_snapshot(self) -> dict:
+        """Like :meth:`snapshot` but each value is ``(kind, value)`` with
+        kind in {counter, gauge, histogram} — exposition formats (the
+        Prometheus endpoint's ``# TYPE`` lines) need the instrument kind,
+        which the flat snapshot erases."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out = {}
+        for name, inst in items:
+            if isinstance(inst, Counter):
+                out[name] = ("counter", inst.value)
+            elif isinstance(inst, Gauge):
+                out[name] = ("gauge", inst.value)
+            else:
+                out[name] = ("histogram", inst.summary())
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._instruments.clear()

@@ -1,0 +1,221 @@
+#!/usr/bin/env python3
+"""Observability-plane smoke: one tiny real federation, every plane hit.
+
+Spawns broker + 2 workers + a coordinator (real subprocesses on real
+ports, CPU) with the full observability plane opted in — flight recorder
+on a fast heartbeat, Prometheus endpoint on an ephemeral port, JSONL
+event stream — then:
+
+- scrapes ``/metrics`` mid-run and validates every line against the
+  Prometheus text-exposition grammar;
+- captures ``/snapshot.json`` mid-run and feeds it to ``colearn top
+  --once`` (replayed from a local server after the run — the CLI's
+  interpreter start-up is slower than the 3-round federation, so
+  pointing it at the live coordinator would race its exit);
+- SIGKILLs a worker mid-run and asserts it left a parseable flight dump
+  (the heartbeat-survivability contract);
+- asserts the event stream carries the start event and one event per
+  round;
+- feeds the flight dir through ``colearn postmortem``.
+
+Exit 0 only if every check passes.  This is the CI ``obs-smoke`` job;
+the SLO sentinel gate (``colearn sentinel``) runs as its own CI step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+_CLI = "colearn_federated_learning_tpu.cli"
+ROUNDS = 3
+N_WORKERS = 2
+
+# Prometheus text exposition 0.0.4: comment lines or `name{labels} value`.
+_PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+naif-]+)$")
+
+
+def _config_flags() -> list[str]:
+    return ["--config", "mnist_mlp_fedavg", "--backend", "cpu",
+            "--dataset", "mnist_tiny", "--partition", "iid",
+            "--num-clients", str(N_WORKERS), "--rounds", str(ROUNDS),
+            "--cohort-size", "0", "--local-steps", "2",
+            "--batch-size", "16", "--min-cohort-fraction", "0.5",
+            "--evict-after", "2", "--seed", "0"]
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="colearn_obs_")
+    flight_dir = os.path.join(workdir, "flight")
+    events_path = os.path.join(workdir, "events.jsonl")
+    env = dict(os.environ, PYTHONUNBUFFERED="1", JAX_PLATFORMS="cpu")
+    cfg = _config_flags()
+    obs = ["--flight-dir", flight_dir, "--flight-heartbeat", "0.5"]
+    failures: list[str] = []
+
+    def check(ok: bool, label: str) -> None:
+        print(f"[obs-smoke] {'ok' if ok else 'FAIL'}: {label}",
+              file=sys.stderr)
+        if not ok:
+            failures.append(label)
+
+    procs: list[subprocess.Popen] = []
+
+    def spawn(args: list[str], **kw) -> subprocess.Popen:
+        p = subprocess.Popen([sys.executable, "-m", _CLI, *args],
+                             env=env, **kw)
+        procs.append(p)
+        return p
+
+    try:
+        broker = spawn(["broker"], stdout=subprocess.PIPE, text=True)
+        addr = json.loads(broker.stdout.readline())
+        host, port = addr["host"], str(addr["port"])
+        for i in range(N_WORKERS):
+            log = open(os.path.join(workdir, f"worker{i}.log"), "ab")
+            spawn(["worker", *cfg, *obs, "--client-id", str(i),
+                   "--broker-host", host, "--broker-port", port],
+                  stdout=log, stderr=log)
+        workers = procs[1:]
+        coord = spawn(
+            ["coordinate", *cfg, *obs,
+             "--metrics-port", "0", "--events-file", events_path,
+             "--broker-host", host, "--broker-port", port,
+             "--min-devices", str(N_WORKERS), "--round-timeout", "25",
+             "--enroll-timeout", "90", "--no-evaluator", "--elastic"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+
+        metrics_port = None
+        victim_pid = None
+        scraped = False
+        snapshot_body = b""
+        for line in coord.stderr:
+            try:
+                doc = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if doc.get("event") == "metrics_port":
+                metrics_port = int(doc["port"])
+            if "round" in doc and not scraped:
+                scraped = True
+                check(metrics_port is not None,
+                      "metrics_port announced before the first round")
+                if metrics_port:
+                    url = f"http://127.0.0.1:{metrics_port}/metrics"
+                    text = urllib.request.urlopen(url, timeout=10) \
+                        .read().decode("utf-8")
+                    lines = [ln for ln in text.splitlines() if ln]
+                    bad = [ln for ln in lines
+                           if not _PROM_LINE.match(ln)]
+                    check(not bad,
+                          f"every /metrics line matches the exposition "
+                          f"grammar (bad: {bad[:3]})")
+                    check(any(ln.startswith("colearn_") for ln in lines),
+                          "scrape carries colearn_* samples")
+                    snapshot_body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{metrics_port}/snapshot.json",
+                        timeout=10).read()
+                    check(bool(json.loads(snapshot_body)),
+                          "/snapshot.json serves the live registry")
+                # Induced kill: the dump the recorder's heartbeat left
+                # behind must survive an uncatchable SIGKILL.
+                victim = workers[-1]
+                victim_pid = victim.pid
+                time.sleep(1.0)          # > one 0.5 s heartbeat period
+                victim.send_signal(signal.SIGKILL)
+        rc = coord.wait(timeout=120)
+        check(rc == 0, f"coordinator exited 0 (got {rc})")
+
+        # Replay the mid-run snapshot for `colearn top --once` so the
+        # render path is exercised on real federation data without
+        # racing the (long-gone) coordinator's exporter.
+        if snapshot_body:
+            import threading
+            from http.server import (BaseHTTPRequestHandler,
+                                     ThreadingHTTPServer)
+
+            class _Replay(BaseHTTPRequestHandler):
+                def do_GET(self):      # noqa: N802 (stdlib handler name)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length",
+                                     str(len(snapshot_body)))
+                    self.end_headers()
+                    self.wfile.write(snapshot_body)
+
+                def log_message(self, fmt, *log_args):
+                    pass
+
+            srv = ThreadingHTTPServer(("127.0.0.1", 0), _Replay)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            try:
+                top = subprocess.run(
+                    [sys.executable, "-m", _CLI, "top", "--once",
+                     "--url", f"http://127.0.0.1:"
+                     f"{srv.server_address[1]}/snapshot.json"],
+                    env=env, capture_output=True, text=True, timeout=60)
+            finally:
+                srv.shutdown()
+                srv.server_close()
+            check(top.returncode == 0 and bool(top.stdout.strip()),
+                  f"colearn top --once renders the captured snapshot"
+                  f" (rc={top.returncode},"
+                  f" err={top.stderr.strip()[:200]!r})")
+        else:
+            check(False, "no /snapshot.json captured mid-run")
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from colearn_federated_learning_tpu.telemetry import flight
+
+        dumps = flight.load_flight_dumps(flight_dir)
+        dumped = {d.get("pid") for d in dumps if "error" not in d}
+        check(victim_pid in dumped,
+              f"SIGKILLed worker pid {victim_pid} left a parseable "
+              f"flight dump (found pids: {sorted(dumped)})")
+
+        with open(events_path) as f:
+            events = [json.loads(ln) for ln in f if ln.strip()]
+        check(any(e.get("event") == "start" for e in events),
+              "event stream carries the start event")
+        n_round_events = sum(1 for e in events if e.get("event") == "round")
+        check(n_round_events >= ROUNDS,
+              f"event stream carries one event per round "
+              f"({n_round_events}/{ROUNDS})")
+
+        pm = subprocess.run(
+            [sys.executable, "-m", _CLI, "postmortem", flight_dir,
+             "--format", "json"],
+            env=env, capture_output=True, text=True, timeout=60)
+        ok_pm = pm.returncode == 0
+        if ok_pm:
+            report = json.loads(pm.stdout)
+            ok_pm = len(report.get("processes", [])) >= 1
+        check(ok_pm, "colearn postmortem parses the flight dir")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+
+    if failures:
+        print(f"[obs-smoke] {len(failures)} check(s) failed",
+              file=sys.stderr)
+        return 1
+    print("[obs-smoke] all checks passed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,8 +65,6 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    import jax.numpy as jnp
-
     from colearn_federated_learning_tpu.data import registry as data_registry
     from colearn_federated_learning_tpu.fed.engine import FederatedLearner
     from colearn_federated_learning_tpu.utils.config import (
@@ -97,24 +95,12 @@ def main() -> None:
     learner = FederatedLearner.from_config(config, dataset=dataset)
     build_s = time.perf_counter() - t0
 
-    # XLA's own FLOP count for one compiled round (forward+backward+opt).
-    t0 = time.perf_counter()
-    # Mirror run_round's ACTUAL operands (a None where run_round passes
-    # the dp_clip scalar would time-compile a variant that never runs).
-    lowered = learner._round_fn.lower(
-        learner.server_state, learner.base_key, jnp.asarray(0, jnp.int32),
-        *learner._device_data, None, None,
-        getattr(learner, "_dp_clip", None),
-    )
-    compiled = lowered.compile()
-    compile_s = time.perf_counter() - t0
-    cost = compiled.cost_analysis()
-    cost = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
-    # XLA cost analysis counts a while/scan BODY ONCE (trip counts are not
-    # modeled), and the local-SGD scan holds essentially all the FLOPs —
-    # verified empirically: the reported count is identical for
-    # local_steps=1 and local_steps=8.  Scale by the step count.
-    flops_per_round = float(cost.get("flops", 0.0)) * learner.num_steps
+    # XLA's own FLOP count for one compiled round (forward+backward+opt),
+    # via the engine's introspection path (telemetry/runtime.py) — same
+    # operands run_round passes, scan body scaled by local steps.
+    cost = learner.round_cost_analysis()
+    compile_s = float(cost.get("compile_s", 0.0))
+    flops_per_round = float(cost.get("flops_per_round", 0.0))
 
     if args.profile_dir:
         learner.fit(rounds=3)                       # traces rounds 1..2

@@ -372,3 +372,29 @@ def test_bench_fleet_writes_schema_valid_jsonl(tmp_path):
     assert rows and rows[0]["cohort"] == 32
     assert rows[0]["clients_per_sec"] > 0
     assert rows[0]["bytes_up_per_round"] > 0
+
+
+# -------------------------------------------------- compile invariant --
+def test_fleetsim_one_compile_per_sweep():
+    """The pad-to-fixed-width contract, machine-checked: a multi-round,
+    multi-chunk sweep (ragged tail chunks AND availability-varying
+    cohorts included) holds exactly ONE compiled signature per jitted
+    executable.  A second chunk signature means the zero-padding broke
+    and every ragged cohort would pay a recompile at fleet scale."""
+    fs = make_fleet(num_devices=128, cohort=48, chunk=16)
+    fs.fit(2)
+    assert fs.compile_counts == {"chunk": 1, "finish": 1, "fold": 1}
+    assert fs._chunk_fn.recompiles == 0
+
+
+def test_cli_fleetsim_reports_compile_counts(capsys):
+    from colearn_federated_learning_tpu.cli import main as cli_main
+
+    rc = cli_main(["fleetsim", "--devices", "64", "--cohort", "24",
+                   "--rounds", "2", "--chunk", "8", "--feature-dim", "8",
+                   "--capacity", "8", "--hidden-dim", "16", "--depth", "1",
+                   "--local-steps", "2", "--batch-size", "4"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["compiles"]["chunk"] == 1
+    assert summary["compiles"]["finish"] == 1

@@ -1,0 +1,231 @@
+"""telemetry/flight.py: the crash flight recorder — atomic dump writes,
+bounded rings, heartbeat SIGKILL survivability (a real subprocess, a
+real uncatchable signal), watchdog stall detection, the corrupt-dump
+contract, and the flight→postmortem round-trip against WAL entries (S4
+wire-format tests)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from colearn_federated_learning_tpu.telemetry import flight
+from colearn_federated_learning_tpu.telemetry.tracer import Tracer
+
+
+def make_recorder(tmp_path, **kw) -> flight.FlightRecorder:
+    # Direct construction (no install()): no signal handlers, no thread —
+    # unit tests drive dump() by hand.
+    kw.setdefault("heartbeat_s", 60.0)
+    return flight.FlightRecorder(str(tmp_path), role="test", **kw)
+
+
+# ------------------------------------------------------------- dumping ---
+def test_dump_writes_parseable_schema(tmp_path):
+    rec = make_recorder(tmp_path)
+    rec.record("round", round=2)
+    path = rec.dump("install")
+    doc = json.loads(open(path).read())
+    assert doc["schema"] == "colearn-flight-v1"
+    assert doc["pid"] == os.getpid()
+    assert doc["role"] == "test"
+    assert doc["trigger"] == "install"
+    assert doc["events"][-1]["kind"] == "round"
+    assert "metrics" in doc and "argv" in doc
+
+
+def test_dump_rewrites_atomically_and_never_raises(tmp_path):
+    rec = make_recorder(tmp_path)
+    first = rec.dump("install")
+    rec.record("round", round=1)
+    second = rec.dump("heartbeat")
+    assert first == second             # same path, rewritten in place
+    docs = flight.load_flight_dumps(str(tmp_path))
+    assert len(docs) == 1             # one black box per pid
+    assert docs[0]["trigger"] == "heartbeat"
+    # No stray tmp files behind the atomic replace.
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    # dump() must not be the second failure: an unwritable dir is eaten.
+    rec.path = os.path.join(str(tmp_path), "nope", "deep", "f.json")
+    rec.dump("heartbeat")              # no raise
+
+
+def test_event_ring_is_bounded(tmp_path):
+    rec = make_recorder(tmp_path)
+    for i in range(2 * flight._EVENT_RING):
+        rec.record("round", round=i)
+    doc = json.loads(open(rec.dump("heartbeat")).read())
+    assert len(doc["events"]) == flight._EVENT_RING
+    assert doc["events"][-1]["round"] == 2 * flight._EVENT_RING - 1
+
+
+def test_attached_tracer_tail_rides_in_dump(tmp_path):
+    tracer = Tracer(process="coordinator")
+    tracer.enabled = True
+    with tracer.span("round", round=1):
+        with tracer.span("aggregate"):
+            pass
+    rec = make_recorder(tmp_path)
+    rec.attach_tracer(tracer)
+    doc = json.loads(open(rec.dump("heartbeat")).read())
+    assert {s["name"] for s in doc["spans"]} == {"round", "aggregate"}
+
+
+def test_exception_payload_recorded(tmp_path):
+    rec = make_recorder(tmp_path)
+    rec.dump("fatal_exception", exc="Traceback ...\nValueError: boom")
+    doc = flight.load_flight_dumps(str(tmp_path))[0]
+    assert doc["trigger"] == "fatal_exception"
+    assert "ValueError: boom" in doc["exception"]
+
+
+def test_watchdog_declares_stall(tmp_path):
+    rec = make_recorder(tmp_path, heartbeat_s=0.05, watchdog_s=0.1)
+    # The stall dump is overwritten by the next heartbeat ~50ms later, so
+    # observe triggers at the dump() boundary rather than racing the file.
+    triggers = []
+    orig_dump = rec.dump
+
+    def spying_dump(trigger, exc=None):
+        triggers.append(trigger)
+        return orig_dump(trigger, exc)
+
+    rec.dump = spying_dump
+    rec.install()
+    try:
+        rec.mark_progress()
+        deadline = time.monotonic() + 5.0
+        while ("watchdog_stall" not in triggers
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert "watchdog_stall" in triggers
+        time.sleep(0.2)                # a few more heartbeats pass...
+    finally:
+        rec.close()
+    # ...but the stall is declared once per quiet period, and the final
+    # rewrite marks a clean shutdown.
+    assert triggers.count("watchdog_stall") == 1
+    assert flight.load_flight_dumps(
+        str(tmp_path))[0]["trigger"] == "shutdown"
+
+
+# -------------------------------------------------------- survivability --
+def test_sigkill_leaves_parseable_dump(tmp_path):
+    """The core contract: SIGKILL is uncatchable, so the last heartbeat
+    rewrite IS the black box — at most one heartbeat stale, and it must
+    parse."""
+    child = (
+        "import time\n"
+        "from colearn_federated_learning_tpu.telemetry import flight\n"
+        f"rec = flight.install_flight_recorder({str(tmp_path)!r},\n"
+        "    role='victim', heartbeat_s=0.2)\n"
+        "rec.record('round', round=3)\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen([sys.executable, "-c", child],
+                         stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        assert p.stdout.readline().strip() == "ready"
+        time.sleep(1.0)                # a few heartbeats
+        p.kill()
+    finally:
+        p.wait()
+    dumps = [d for d in flight.load_flight_dumps(str(tmp_path))
+             if "error" not in d]
+    assert [d["pid"] for d in dumps] == [p.pid]
+    assert dumps[0]["role"] == "victim"
+    assert any(e.get("round") == 3 for e in dumps[0]["events"])
+
+
+def test_unparseable_dump_is_a_finding_not_a_skip(tmp_path):
+    (tmp_path / "flight_123.json").write_text('{"pid": 123, "tru')
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "flight_456.json").write_text(
+        json.dumps({"schema": "colearn-flight-v1", "pid": 456, "ts": 1.0}))
+    docs = flight.load_flight_dumps(str(tmp_path))
+    good = [d for d in docs if "error" not in d]
+    bad = [d for d in docs if "error" in d]
+    assert [d["pid"] for d in good] == [456]   # recursive walk found it
+    assert len(bad) == 1 and bad[0]["_path"].endswith("flight_123.json")
+
+
+# ----------------------------------------------------------- postmortem --
+def _dump_for(tmp_path, pid, rounds, trigger="heartbeat"):
+    doc = {"schema": "colearn-flight-v1", "pid": pid, "role": "worker",
+           "trigger": trigger, "ts": float(pid), "argv": [],
+           "events": [{"ts": 0.0, "kind": "round", "round": r}
+                      for r in rounds],
+           "metrics": {"comm.retry_total": 2.0}, "spans": []}
+    (tmp_path / f"flight_{pid}.json").write_text(json.dumps(doc))
+
+
+def test_postmortem_splits_committed_vs_in_flight_exactly(tmp_path):
+    wal = [{"round": r, "accepted": 2, "completed": 2,
+            "total_weight": 10.0} for r in (1, 2, 3, 4)]
+    report = flight.postmortem_report([], wal_entries=wal,
+                                      checkpoint_step=3)
+    assert report["last_committed_round"] == 3
+    assert report["committed_rounds"] == 3
+    assert report["rounds_in_flight"] == [4]
+
+
+def test_postmortem_infers_in_flight_from_dumps(tmp_path):
+    _dump_for(tmp_path, 100, rounds=[1, 2, 3])
+    dumps = flight.load_flight_dumps(str(tmp_path))
+    wal = [{"round": 1}, {"round": 2}]
+    report = flight.postmortem_report(dumps, wal_entries=wal)
+    assert report["last_committed_round"] == 2
+    assert report["rounds_in_flight"] == [3]   # seen by a dump, not in WAL
+    proc = report["processes"][0]
+    assert proc["pid"] == 100
+    assert proc["last_round_seen"] == 3
+    assert proc["metrics_of_note"] == {"comm.retry_total": 2.0}
+
+
+def test_postmortem_roundtrip_through_files(tmp_path):
+    """S4: recorder dump -> disk -> load_flight_dumps -> report -> JSON
+    round-trips without loss of the crash story."""
+    rec = flight.FlightRecorder(str(tmp_path), role="coordinator",
+                                heartbeat_s=60.0)
+    rec.record("round", round=5)
+    rec.dump("sigterm")
+    _dump_for(tmp_path, 7, rounds=[4], trigger="watchdog_stall")
+    dumps = flight.load_flight_dumps(str(tmp_path))
+    report = flight.postmortem_report(
+        dumps, wal_entries=[{"round": 4}], checkpoint_step=1)
+    report2 = json.loads(json.dumps(report))
+    assert report2["schema"] == "colearn-postmortem-v1"
+    assert report2["process_count"] == 2
+    assert sorted(report2["crash_triggers"]) == ["sigterm",
+                                                "watchdog_stall"]
+    rendered = flight.render_postmortem(report2)
+    assert str(os.getpid()) in rendered
+    assert "sigterm" in rendered
+
+
+def test_render_postmortem_reports_unparseable(tmp_path):
+    (tmp_path / "flight_9.json").write_text("not json")
+    report = flight.postmortem_report(
+        flight.load_flight_dumps(str(tmp_path)))
+    assert "error" in report["processes"][0]
+    assert "[unparseable]" in flight.render_postmortem(report)
+
+
+def test_install_is_idempotent_per_process(tmp_path):
+    """The module singleton: worker + engine may both ask; one recorder."""
+    import colearn_federated_learning_tpu.telemetry.flight as fl
+
+    prev = fl._recorder
+    fl._recorder = None
+    try:
+        a = fl.install_flight_recorder(str(tmp_path), role="worker",
+                                       heartbeat_s=60.0)
+        b = fl.install_flight_recorder(str(tmp_path / "other"))
+        assert a is b
+        assert fl.get_flight_recorder() is a
+        a.close()
+    finally:
+        fl._recorder = prev

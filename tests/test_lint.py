@@ -44,7 +44,7 @@ def test_cl001_flags_print_in_jit_decorated_function(tmp_path):
         def step(x):
             print("tracing", x)
             return x
-    """, relpath="pkg/fed/mod.py")
+    """, relpath="pkg/fed/mod.py", rules=["CL001"])
     assert rule_ids(res) == ["CL001"]
     assert res.exit_code == 1
 
@@ -71,16 +71,18 @@ def test_cl001_suppression(tmp_path):
         def step(x):
             print("trace marker")  # colearn: noqa(CL001)
             return x
-    """, relpath="pkg/fed/mod.py")
+    """, relpath="pkg/fed/mod.py", rules=["CL001"])
     assert res.findings == [] and res.suppressed == 1
 
 
 def test_cl001_ignores_untraced_functions(tmp_path):
+    # Scoped to CL001: a host-side stdout print is fine by THIS rule
+    # (CL010 has its own opinion about library stdout).
     res = run_lint(tmp_path, """
         def host_side(x):
             print(x)
             return x
-    """, relpath="pkg/fed/mod.py")
+    """, relpath="pkg/fed/mod.py", rules=["CL001"])
     assert res.findings == []
 
 
@@ -234,6 +236,26 @@ def test_cl005_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def bump(registry):
             registry.counter("scratch.local_only").inc()  # colearn: noqa(CL005)
+    """, relpath="pkg/fed/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl005_flags_non_literal_metric_name(tmp_path):
+    # A plain variable slips past catalog validation entirely — the
+    # hardened rule reports it instead of silently passing.
+    res = run_lint(tmp_path, """
+        def bump(registry, name):
+            registry.counter(name).inc()
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL005"]
+    assert "non-literal" in res.findings[0].message
+
+
+def test_cl005_non_literal_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def snapshot(registry, names):
+            return {n: registry.counter(n).value  # colearn: noqa(CL005)
+                    for n in names}
     """, relpath="pkg/fed/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
@@ -451,6 +473,67 @@ def test_cl009_suppression(tmp_path):
             for device_id in cohort_ids:  # colearn: hot  # colearn: noqa(CL009)
                 train_one(device_id)
     """, relpath="pkg/fleetsim/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL010 ----
+def test_cl010_flags_print_to_stdout_in_library_code(tmp_path):
+    res = run_lint(tmp_path, """
+        def announce(port):
+            print({"port": port})
+    """, relpath="pkg/comm/mod.py")
+    assert rule_ids(res) == ["CL010"]
+    assert res.exit_code == 1
+
+
+def test_cl010_flags_explicit_sys_stdout(tmp_path):
+    res = run_lint(tmp_path, """
+        import sys
+
+        def announce(port):
+            print(port, file=sys.stdout)
+    """, relpath="pkg/fed/mod.py")
+    assert rule_ids(res) == ["CL010"]
+
+
+def test_cl010_allows_stderr_and_file_objects(tmp_path):
+    res = run_lint(tmp_path, """
+        import sys
+
+        def announce(port, log):
+            print(port, file=sys.stderr)
+            print(port, file=log)
+    """, relpath="pkg/comm/mod.py")
+    assert res.findings == []
+
+
+def test_cl010_exempts_cli_scripts_and_main_guards(tmp_path):
+    src = """
+        def report(x):
+            print(x)
+    """
+    # cli.py and bench.py ARE the stdout contract (machine-readable
+    # summary lines); scripts/ is operator tooling.
+    assert run_lint(tmp_path, src, relpath="pkg/cli.py").findings == []
+    assert run_lint(tmp_path, src, relpath="pkg/bench.py").findings == []
+    assert run_lint(tmp_path, src,
+                    relpath="pkg/scripts/tool.py").findings == []
+    # __main__ guard: the module is being run AS a script.
+    res = run_lint(tmp_path, """
+        def build():
+            return "x"
+
+        if __name__ == "__main__":
+            print(build())
+    """, relpath="pkg/native/build.py")
+    assert res.findings == []
+
+
+def test_cl010_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def report(x):
+            print(x)  # colearn: noqa(CL010)
+    """, relpath="pkg/fed/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
 

@@ -102,3 +102,16 @@ def test_proc_soak_coordinator_sigkill_resumes(tmp_path):
     assert s["coordinator_incarnations"] == 2
     assert len(s["kills"]) == 1
     assert s["weighted_acc"] is not None
+    # Flight-recorder survivability: the SIGKILLed coordinator pid must
+    # have left a parseable black box (its last heartbeat rewrite), and
+    # the kill ledger records which pid took the signal.
+    assert all("pid" in k for k in s["kills"])
+    assert s["flight_missing"] == []
+    assert s["flight_dumps"] >= 1
+    from colearn_federated_learning_tpu.telemetry import flight
+
+    dumps = flight.load_flight_dumps(str(tmp_path / "flight"))
+    by_pid = {d.get("pid"): d for d in dumps if "error" not in d}
+    victim = by_pid[s["kills"][0]["pid"]]
+    assert victim["schema"] == "colearn-flight-v1"
+    assert victim["role"] == "coordinator"

@@ -1,0 +1,217 @@
+"""analysis/sentinel.py: declarative SLO rules over results JSONL —
+rule validation, where-filters, aggregation bounds, missing-data
+semantics, reorder stability (S4 wire-format contract), the torn-tail
+JSONL loader, and the `colearn sentinel` CLI gate exiting non-zero on an
+injected rounds/sec regression."""
+
+import json
+
+import pytest
+
+from colearn_federated_learning_tpu.analysis import sentinel
+from colearn_federated_learning_tpu.cli import main as cli_main
+
+
+def write_rows(path, rows):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+
+def write_rules(root, rules_toml):
+    (root / "pyproject.toml").write_text(
+        "[tool.colearn.slo]\n" + rules_toml)
+
+
+FLEET_ROWS = [
+    {"bench": "fleet_round", "devices": 1000, "rounds_per_sec": 27.7},
+    {"bench": "fleet_round", "devices": 1000000, "rounds_per_sec": 0.022},
+    {"bench": "fleet_round", "devices": 1000000, "rounds_per_sec": 0.031},
+]
+
+
+# ----------------------------------------------------------- rule shape --
+def test_rule_validation_rejects_bad_tables():
+    with pytest.raises(ValueError, match="order-independent"):
+        sentinel.SloRule(id="r", file="f", field="x", agg="last", min=0)
+    with pytest.raises(ValueError, match="min and/or max"):
+        sentinel.SloRule(id="r", file="f", field="x", agg="min")
+    with pytest.raises(ValueError, match="needs a field"):
+        sentinel.SloRule(id="r", file="f", agg="mean", min=0)
+    with pytest.raises(ValueError, match="unknown keys"):
+        sentinel.SloRule.from_table(
+            {"id": "r", "file": "f", "field": "x", "min": 0,
+             "threshold": 1})
+
+
+def test_duplicate_rule_ids_rejected(tmp_path):
+    write_rules(tmp_path, """
+[[tool.colearn.slo.rules]]
+id = "dup"
+file = "results/a.jsonl"
+field = "x"
+min = 0
+
+[[tool.colearn.slo.rules]]
+id = "dup"
+file = "results/b.jsonl"
+field = "x"
+min = 0
+""")
+    with pytest.raises(ValueError, match="duplicate"):
+        sentinel.load_rules(str(tmp_path))
+
+
+# ----------------------------------------------------------- evaluation --
+def test_where_filter_and_min_bound(tmp_path):
+    write_rows(tmp_path / "results" / "fleet.jsonl", FLEET_ROWS)
+    rule = sentinel.SloRule(
+        id="r", file="results/fleet.jsonl", field="rounds_per_sec",
+        agg="min", where={"devices": 1000000}, min=0.01)
+    res = rule.evaluate(str(tmp_path))
+    assert res["ok"] and res["rows"] == 2 and res["value"] == 0.022
+
+
+def test_violation_reports_reason(tmp_path):
+    write_rows(tmp_path / "results" / "fleet.jsonl", FLEET_ROWS)
+    rule = sentinel.SloRule(
+        id="r", file="results/fleet.jsonl", field="rounds_per_sec",
+        agg="min", where={"devices": 1000000}, min=5.0)
+    res = rule.evaluate(str(tmp_path))
+    assert not res["ok"]
+    assert res["reason"].startswith("below_min:")
+
+
+def test_max_bound_and_count_agg(tmp_path):
+    write_rows(tmp_path / "results" / "fleet.jsonl", FLEET_ROWS)
+    over = sentinel.SloRule(
+        id="hi", file="results/fleet.jsonl", field="rounds_per_sec",
+        agg="max", max=10.0)
+    assert not over.evaluate(str(tmp_path))["ok"]     # 27.7 > 10
+    count = sentinel.SloRule(
+        id="n", file="results/fleet.jsonl", agg="count",
+        where={"devices": 1000000}, min=2)
+    assert count.evaluate(str(tmp_path))["ok"]
+
+
+def test_missing_file_and_rows_are_violations_unless_allowed(tmp_path):
+    rule = sentinel.SloRule(
+        id="r", file="results/nope.jsonl", field="x", min=0)
+    res = rule.evaluate(str(tmp_path))
+    assert not res["ok"] and res["reason"] == "file_missing"
+    allowed = sentinel.SloRule(
+        id="r", file="results/nope.jsonl", field="x", min=0,
+        allow_missing=True)
+    assert allowed.evaluate(str(tmp_path))["ok"]
+    write_rows(tmp_path / "results" / "fleet.jsonl", FLEET_ROWS)
+    nomatch = sentinel.SloRule(
+        id="r", file="results/fleet.jsonl", field="rounds_per_sec",
+        where={"devices": 7}, min=0)
+    assert nomatch.evaluate(str(tmp_path))["reason"] == "no_matching_rows"
+
+
+def test_empty_rule_set_is_not_a_green_verdict(tmp_path):
+    verdict = sentinel.evaluate_slo(str(tmp_path))
+    assert verdict["rules"] == 0
+    assert not verdict["ok"]          # fake green forbidden
+
+
+def test_verdict_is_stable_under_row_reordering(tmp_path):
+    """S4: every offered aggregation is order-independent, so merging
+    shards or appending re-runs in any order must produce byte-identical
+    rule results."""
+    rules = [
+        sentinel.SloRule(id="lo", file="results/f.jsonl",
+                         field="rounds_per_sec", agg="min",
+                         where={"devices": 1000000}, min=0.01),
+        sentinel.SloRule(id="mean", file="results/f.jsonl",
+                         field="rounds_per_sec", agg="mean", max=50.0),
+        sentinel.SloRule(id="n", file="results/f.jsonl", agg="count",
+                         min=3),
+    ]
+    write_rows(tmp_path / "results" / "f.jsonl", FLEET_ROWS)
+    forward = sentinel.evaluate_slo(str(tmp_path), rules=rules)
+    write_rows(tmp_path / "results" / "f.jsonl", FLEET_ROWS[::-1])
+    backward = sentinel.evaluate_slo(str(tmp_path), rules=rules)
+    assert forward["results"] == backward["results"]
+    assert forward["ok"] and backward["ok"]
+
+
+# -------------------------------------------------------------- loading --
+def test_jsonl_loader_tolerates_torn_tail_only(tmp_path):
+    p = tmp_path / "rows.jsonl"
+    p.write_text('{"a": 1}\n{"a": 2}\n{"a": 3, "tru')
+    assert [r["a"] for r in sentinel.load_jsonl_rows(str(p))] == [1, 2]
+    p.write_text('{"a": 1}\n{"a": 2, "tru\n{"a": 3}\n')
+    with pytest.raises(ValueError, match="corrupt JSONL"):
+        sentinel.load_jsonl_rows(str(p))
+
+
+def test_load_rules_from_pyproject(tmp_path):
+    write_rules(tmp_path, """
+[[tool.colearn.slo.rules]]
+id = "fleet"
+file = "results/f.jsonl"
+where = { devices = 1000000 }
+field = "rounds_per_sec"
+agg = "min"
+min = 0.01
+""")
+    rules = sentinel.load_rules(str(tmp_path))
+    assert len(rules) == 1
+    assert rules[0].where == {"devices": 1000000}
+
+
+# ------------------------------------------------------------ CLI gate --
+def test_cli_sentinel_fails_on_injected_regression(tmp_path, capsys):
+    """The acceptance fixture: a committed rounds/sec that regressed
+    below the SLO floor must exit non-zero (and say why)."""
+    write_rules(tmp_path, """
+[[tool.colearn.slo.rules]]
+id = "fleet-1m-round-rate"
+file = "results/fleet_bench.jsonl"
+where = { devices = 1000000 }
+field = "rounds_per_sec"
+agg = "min"
+min = 0.01
+""")
+    write_rows(tmp_path / "results" / "fleet_bench.jsonl",
+               [{"devices": 1000000, "rounds_per_sec": 0.002}])
+    rc = cli_main(["sentinel", "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "below_min" in out and "VIOLATION" in out
+
+    # Fix the regression: same rules, healthy number, exit 0.
+    write_rows(tmp_path / "results" / "fleet_bench.jsonl",
+               [{"devices": 1000000, "rounds_per_sec": 0.03}])
+    assert cli_main(["sentinel", "--root", str(tmp_path)]) == 0
+
+
+def test_cli_sentinel_json_verdict(tmp_path, capsys):
+    write_rules(tmp_path, """
+[[tool.colearn.slo.rules]]
+id = "n"
+file = "results/f.jsonl"
+agg = "count"
+min = 1
+""")
+    write_rows(tmp_path / "results" / "f.jsonl", [{"x": 1}])
+    rc = cli_main(["sentinel", "--root", str(tmp_path),
+                   "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["schema"] == "colearn-slo-verdict-v1"
+    assert doc["ok"] and doc["rules"] == 1
+
+
+def test_repo_slo_rules_hold_against_committed_results():
+    """The CI gate itself: the repo's own [tool.colearn.slo] rules must
+    pass against the committed results/*.jsonl."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rules = sentinel.load_rules(root)
+    if not rules:
+        pytest.skip("no tomllib/tomli available")
+    verdict = sentinel.evaluate_slo(root, rules=rules)
+    assert verdict["ok"], verdict["results"]
