@@ -43,6 +43,16 @@ COUNTERS = (
     "comm.broadcast_encode_total",   # CLW1 encodes of a broadcast frame
     "comm.bytes_saved_downlink",     # delta vs full-params payload bytes
     "comm.resync_total",             # worker cache misses → full re-send
+    # key exchange & broker healing (comm/keyexchange.py, comm/coordinator.py)
+    "comm.keyexchange_rejected_total",  # labeled {reason=zero|identity|...}
+    "comm.broker_reconnects_total",     # labeled {outcome=ok|failed}
+    # dropout-tolerant secure aggregation (privacy/dropout.py,
+    # comm/coordinator.py share phase + mask recovery)
+    "privacy.shares_distributed_total",     # encrypted share blobs relayed
+    "privacy.shares_collected_total",       # reveal shares received back
+    "privacy.self_masks_removed_total",     # b_u reconstructions applied
+    "privacy.masks_recovered_total",        # labeled {device=<dropped id>}
+    "privacy.share_recovery_failures_total",  # labeled {stage=<where>}
     # fault plane (faults/inject.py)
     "fault.injected_total",
     "fault.injected.*",              # per-kind family
@@ -106,11 +116,25 @@ SOAK_DELTA_COUNTERS = (
     "fed.rounds_skipped_quorum",
 )
 
+# Additional deltas the SECURE soak flavor reports (faults/soak.py
+# run_secure_soak).  Kept separate from SOAK_DELTA_COUNTERS so the
+# classic chaos-soak report — and the tests pinning it — are unchanged.
+SECURE_SOAK_DELTA_COUNTERS = (
+    "privacy.shares_distributed_total",
+    "privacy.shares_collected_total",
+    "privacy.self_masks_removed_total",
+    "privacy.masks_recovered_total",
+    "privacy.share_recovery_failures_total",
+    "fed.rounds_skipped_quorum",
+    "fault.injected_total",
+)
+
 METRICS: frozenset = frozenset(COUNTERS) | frozenset(GAUGES) | frozenset(
     HISTOGRAMS
 )
 
 assert set(SOAK_DELTA_COUNTERS) <= set(COUNTERS)
+assert set(SECURE_SOAK_DELTA_COUNTERS) <= set(COUNTERS)
 
 _WILDCARDS = tuple(sorted(m[:-1] for m in METRICS if m.endswith(".*")))
 

@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL010).
+"""The colearn rule set (CL001–CL011).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -574,3 +574,82 @@ class NoPrintInLibrary(Rule):
                 "print() to stdout in library code interleaves with the "
                 "machine-readable stdout contract; use the metrics/event "
                 "plane or stderr")
+
+
+# ----------------------------------------------------------------- CL011 --
+@register
+class PerPairLoopInMaskingHotPath(Rule):
+    """Secure-aggregation mask expansion is ONE vectorized dispatch:
+    build the (P, 2) pair-key table, then a single
+    ``pairwise_mask_with_keys`` / ``mask_update_with_keys`` call expands
+    every pair's PRG stream inside one jitted ``fori_loop``
+    (privacy/secure_agg.py).  A Python loop that calls a mask expander
+    once per pair pays a dispatch — and, called eagerly, a full
+    retrace+compile — per pair; under the secure chaos soak that turned
+    sub-second rounds into deadline blowouts.  Deriving the pair KEYS
+    per pair (``shared_secret`` / ``pair_prng_key``, one scalar modexp
+    each) is the sanctioned loop shape and is exempt."""
+
+    id = "CL011"
+    title = "per-pair Python loop in a hot masking path"
+    hint = ("build the pair-key table once and make a single "
+            "*_with_keys call (privacy/secure_agg."
+            "pairwise_mask_with_keys); mark a justified per-pair loop "
+            "with `# colearn: noqa(CL011)`")
+
+    _EXPANDERS = {"pairwise_mask", "mask_update", "mask_scalar",
+                  "pairwise_mask_with_keys", "mask_update_with_keys",
+                  "_sample_tree"}
+    _KEY_DERIVATION = {"shared_secret", "pair_prng_key"}
+    _WORDS = ("pair", "partner", "peer", "neighbor")
+    _LOOPS = (ast.For, ast.While, ast.ListComp, ast.SetComp, ast.DictComp,
+              ast.GeneratorExp)
+
+    def _idents(self, node: ast.AST) -> Iterator[str]:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                yield n.id
+            elif isinstance(n, ast.Attribute):
+                yield n.attr
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not (ctx.in_dir("privacy") or ctx.in_dir("comm")):
+            return
+        hot = ctx.hot_lines()
+        if not hot:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, self._LOOPS) and node.lineno in hot):
+                continue
+            tails = {dotted_name(inner.func).rsplit(".", 1)[-1]
+                     for inner in ast.walk(node)
+                     if isinstance(inner, ast.Call)}
+            # (a) a mask expander called once per iteration.
+            expanded = sorted(tails & self._EXPANDERS)
+            if expanded:
+                yield self.finding(
+                    ctx, node,
+                    f"{expanded[0]}() called once per iteration of a "
+                    "`# colearn: hot` loop: one dispatch (and possibly "
+                    "one retrace) per pair — make a single *_with_keys "
+                    "call over the pair-key table")
+                continue
+            # (b) the loop head names a per-pair quantity (and the body
+            # is not just the sanctioned scalar key derivation).
+            if tails & self._KEY_DERIVATION:
+                continue
+            if isinstance(node, ast.For):
+                head: tuple = (node.target, node.iter)
+            elif isinstance(node, ast.While):
+                head = (node.test,)
+            else:
+                head = tuple(part for comp in node.generators
+                             for part in (comp.target, comp.iter))
+            per_pair = [i for h in head for i in self._idents(h)
+                        if any(w in i.lower() for w in self._WORDS)]
+            if per_pair:
+                yield self.finding(
+                    ctx, node,
+                    f"`# colearn: hot` loop iterates per "
+                    f"{per_pair[0]!r}: pairs must be a table axis — "
+                    "expand every mask in one *_with_keys dispatch")

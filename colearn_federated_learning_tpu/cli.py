@@ -383,14 +383,21 @@ def cmd_broker(args: argparse.Namespace) -> int:
 
     from colearn_federated_learning_tpu.comm.broker import MessageBroker
 
+    exporter, events, recorder = _setup_observability(args, role="broker")
     broker = MessageBroker(host=args.host, port=args.port).start()
     print(json.dumps({"host": broker.host, "port": broker.port}), flush=True)
+    if recorder is not None:
+        recorder.record("broker_listening", port=broker.port)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
         pass
     finally:
         broker.stop()
+        if events is not None:
+            events.emit("stop", role="broker")
+        if exporter is not None:
+            exporter.stop()
     return 0
 
 
@@ -571,7 +578,14 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     process, a fault plan installed after the warmup round (faults/soak).
     ``--mp``: broker, coordinator and workers as real subprocesses on
     real ports, SIGKILLed on a seeded schedule — including the
-    coordinator, which must come back with --resume (faults/procsoak)."""
+    coordinator, which must come back with --resume (faults/procsoak).
+    ``--secure``: DH secure-aggregation federation vs a plain-FedAvg
+    oracle in lockstep, maskers dropped after-fold/before-unmask; exact
+    per-round param agreement is the gate (faults/soak.run_secure_soak)."""
+    if args.secure and args.mp:
+        print("--secure is an in-process exactness gate; drop --mp",
+              file=sys.stderr)
+        return 2
     if args.mp:
         from colearn_federated_learning_tpu.faults import procsoak
 
@@ -605,6 +619,33 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         pass
     from colearn_federated_learning_tpu import faults
 
+    if args.secure:
+        from colearn_federated_learning_tpu.faults import soak
+
+        if args.no_faults:
+            plan = faults.FaultPlan([], seed=0)
+        elif args.fault_plan:
+            plan = faults.FaultPlan.load(args.fault_plan,
+                                         seed=args.fault_seed or None)
+        else:
+            plan = soak.canned_secure_plan(
+                seed=args.fault_seed if args.fault_seed is not None else 11)
+        summary = soak.run_secure_soak(
+            rounds=args.rounds, n_workers=args.num_workers, plan=plan,
+            round_timeout=args.round_timeout,
+            log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+        )
+        print(json.dumps(summary))
+        counters = summary["counters"]
+        ok = (summary["rounds_run"] == args.rounds
+              and summary["oracle_ok"]
+              and not summary["skipped_rounds"]
+              and counters["privacy.share_recovery_failures_total"] == 0
+              # With faults scheduled, recovery must actually have run —
+              # a gate that never exercised unmasking proves nothing.
+              and (not plan.faults
+                   or counters["privacy.masks_recovered_total"] >= 1))
+        return 0 if ok else 1
     if args.no_faults:
         plan = None
     elif args.fault_plan:
@@ -929,6 +970,7 @@ def main(argv: list[str] | None = None) -> int:
                                              "broker (MQTT equivalent)")
     p_broker.add_argument("--host", default="127.0.0.1")
     p_broker.add_argument("--port", type=int, default=0)
+    _add_observability_flags(p_broker)
     p_broker.set_defaults(fn=cmd_broker)
 
     p_worker = sub.add_parser("worker", help="run a device worker process "
@@ -1002,6 +1044,12 @@ def main(argv: list[str] | None = None) -> int:
                          help="soak with downlink delta compression on "
                               "(exercises the cache-miss resync path "
                               "under faults)")
+    p_chaos.add_argument("--secure", action="store_true",
+                         help="secure-aggregation exactness gate: DH "
+                              "masked federation vs plain-FedAvg oracle "
+                              "in lockstep under the dropout plan; fails "
+                              "unless every round's recovered sum matches "
+                              "the oracle (faults/soak.run_secure_soak)")
     p_chaos.add_argument("--mp", action="store_true",
                          help="multi-process soak: broker/coordinator/"
                               "workers as real subprocesses, real SIGKILL "

@@ -126,6 +126,20 @@ class StreamingFolder(UpdateFolder):
         self.folded_ids = ids
         self._staged.clear()
 
+    def apply_correction(self, tree: Any) -> None:
+        """Subtract a correction term from the finalized weighted sum —
+        the secure-agg recovery hook: reconstructed self-masks and orphaned
+        pair-mask halves are removed as ONE final term, never by
+        densifying and re-summing the folded updates."""
+        if not self._finalized:
+            raise RuntimeError(
+                "apply_correction requires a finalized fold (the "
+                "correction is defined relative to the completed sum)"
+            )
+        if self.wsum is None:
+            return
+        self.wsum = pytrees.tree_sub(self.wsum, tree)
+
     def mean(self) -> tuple[Optional[Any], float, float]:
         self.finalize()
         return super().mean()

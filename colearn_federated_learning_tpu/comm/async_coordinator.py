@@ -86,9 +86,10 @@ class AsyncFederatedCoordinator:
         if config.fed.secure_agg:
             raise NotImplementedError(
                 "asynchronous aggregation with secure_agg is unsupported: "
-                "pairwise masks need an agreed per-round cohort, which the "
-                "per-device pumps don't have; use the synchronous "
-                "coordinator"
+                "pairwise masks need an agreed per-round cohort, and the "
+                "dropout-recovery share distribution (privacy/dropout.py) "
+                "is a round-scoped synchronous fan-out the per-device "
+                "pumps don't have; use the synchronous coordinator"
             )
         if config.fed.compress_down != "none":
             raise NotImplementedError(

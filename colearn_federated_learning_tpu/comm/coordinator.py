@@ -59,6 +59,7 @@ class FederatedCoordinator:
         want_evaluator: bool = True,
         mud_policy=None,
         device_type: Optional[str] = None,
+        share_timeout_fraction: float = 0.25,
     ):
         """``mud_policy``: optional :class:`comm.mud.MudPolicy` gating
         enrollment by RFC 8520 device identity (the CoLearn pattern).
@@ -78,8 +79,21 @@ class FederatedCoordinator:
                 "secure_agg_neighbors must be an even integer >= 2, got "
                 f"{config.fed.secure_agg_neighbors}"
             )
+        if config.fed.secure_agg and not (
+            0.0 < config.fed.secure_agg_threshold <= 1.0
+        ):
+            raise ValueError(
+                "secure_agg_threshold must be in (0, 1], got "
+                f"{config.fed.secure_agg_threshold}"
+            )
         validate_robustness(config)
         self.round_timeout = round_timeout
+        # Share-distribution deadline as a fraction of the round budget:
+        # a masker too slow to distribute its recovery shares is PRUNED
+        # from the cohort here (straggler-aware pruning) instead of
+        # becoming an unrecoverable dropout at unmask time.  The train
+        # fan-out gets whatever remains of the round budget.
+        self.share_timeout_fraction = share_timeout_fraction
         self.want_evaluator = want_evaluator
         # Bounded retry for transient transport failures, budgeted against
         # the shared round deadline (transport.RetryPolicy); comm_retries=0
@@ -97,6 +111,9 @@ class FederatedCoordinator:
         # metadata so one trace covers the whole federation.  The CLI
         # writes it to RunConfig.trace_dir after fit.
         self.tracer = telemetry.Tracer(process="coordinator")
+        self._broker_addr = (broker_host, broker_port)
+        self._mud_policy = mud_policy
+        self._device_type = device_type
         self._broker = BrokerClient(broker_host, broker_port,
                                     timeout=protocol.CONNECT_TIMEOUT)
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy,
@@ -183,8 +200,45 @@ class FederatedCoordinator:
             admit_late_joiners,
         )
 
-        return admit_late_joiners(self._enroll, self._broker, self.trainers,
-                                  self.evaluator, self._clients, poll)
+        if not self._broker.alive():
+            # Control-plane SPOF healed in place: a SIGKILLed-and-restarted
+            # broker loses our enrollment subscription (the manager's poll
+            # SWALLOWS the dead-socket error, so without this check the
+            # coordinator would silently never see another announcement).
+            # Workers re-announce via their own broker watchdog; the fresh
+            # manager's retained-topic subscription replays them.
+            self._rebuild_broker()
+        try:
+            return admit_late_joiners(self._enroll, self._broker,
+                                      self.trainers, self.evaluator,
+                                      self._clients, poll)
+        except (OSError, protocol.ConnectionClosed):
+            # Broker died between the liveness check and the poll/publish
+            # (a SIGKILL mid-recv surfaces as ConnectionClosed, not
+            # OSError — the multi-process broker-kill soak hits exactly
+            # this window).
+            self._rebuild_broker()
+            return []
+
+    def _rebuild_broker(self) -> None:
+        """Reconnect the control plane after a broker death.  Rounds keep
+        running either way (training rides direct tensor connections; only
+        membership refresh and DH pubkey lookups need the broker), but the
+        outcome is counted, never silent."""
+        reg = telemetry.get_registry()
+        try:
+            fresh = BrokerClient(self._broker_addr[0], self._broker_addr[1],
+                                 timeout=protocol.CONNECT_TIMEOUT)
+        except OSError:
+            reg.counter("comm.broker_reconnects_total",
+                        labels={"outcome": "failed"}).inc()
+            return
+        self._broker.close()
+        self._broker = fresh
+        self._enroll = EnrollmentManager(fresh, mud_policy=self._mud_policy,
+                                         device_type=self._device_type)
+        reg.counter("comm.broker_reconnects_total",
+                    labels={"outcome": "ok"}).inc()
 
     def _note_round_outcome(self, cohort, dropped) -> list[str]:
         """Track consecutive failures; evict peers dead for
@@ -246,10 +300,11 @@ class FederatedCoordinator:
                 max_workers=self._pool_size, thread_name_prefix="fanout")
         return self._pool
 
-    def _fan_out(self, devs, ask, on_result=None):
-        """Fan ``ask`` out over ``devs`` racing ONE shared round_timeout
-        deadline (sequential per-future timeouts would stack; each ask's
-        retries are budgeted against the same deadline).
+    def _fan_out(self, devs, ask, on_result=None, timeout=None):
+        """Fan ``ask`` out over ``devs`` racing ONE shared deadline
+        (``timeout``, default round_timeout; sequential per-future
+        timeouts would stack; each ask's retries are budgeted against the
+        same deadline).
 
         Replies are consumed AS THEY ARRIVE (``cf.as_completed``) on this
         collector thread; ``on_result(dev, result)`` runs per arrival —
@@ -264,8 +319,9 @@ class FederatedCoordinator:
         connection.  Returns (results, failed_devices), ``failed`` in
         ``devs`` order."""
         self._abandoned = [f for f in self._abandoned if not f.done()]
+        budget = self.round_timeout if timeout is None else timeout
         results, failed_ids, handled = [], set(), set()
-        deadline = time.monotonic() + self.round_timeout
+        deadline = time.monotonic() + budget
         pool = self._executor(len(devs))
         futs = {pool.submit(ask, d, deadline): d for d in devs}  # colearn: hot
 
@@ -282,7 +338,7 @@ class FederatedCoordinator:
             results.append(res)
 
         try:
-            for fut in cf.as_completed(futs, timeout=self.round_timeout):
+            for fut in cf.as_completed(futs, timeout=budget):
                 take(fut, futs[fut])
         except cf.TimeoutError:   # colearn: noqa(CL003)
             pass  # stragglers handled below: dropped, counted, reconnected
@@ -339,9 +395,27 @@ class FederatedCoordinator:
 
     def _run_round_traced(self, r: int) -> dict:
         cohort = self._sample_cohort(r)
+        cohort_full = list(cohort)
         # The thread-local round span context, captured HERE because the
         # fan-out asks run on pool threads where it is not implicit.
         ctx = self.tracer.current_context()
+        round_t0 = time.monotonic()
+        secure = self.config.fed.secure_agg
+        dh = secure and self.config.fed.secure_agg_key_exchange == "dh"
+        share_info = None
+        pruned: list[str] = []
+        if dh:
+            # Phase 1 of the dropout-tolerant round: every cohort member
+            # distributes this round's recovery shares BEFORE any mask is
+            # committed.  Members that miss the share deadline are pruned
+            # from the cohort — they never mask, so their death can never
+            # orphan a mask half (privacy/dropout.py).
+            with self.tracer.span("share_setup", cohort=len(cohort)):
+                share_info, share_failed = self._share_phase(r, cohort, ctx)
+            if share_failed:
+                pruned = [d.device_id for d in share_failed]
+                cut = set(pruned)
+                cohort = [d for d in cohort if d.device_id not in cut]
         with self.tracer.span("serialize_params"):
             params_np = jax.tree.map(np.asarray, self.server_state.params)
             # ONE encode + crc for the whole cohort (serialize-once): every
@@ -350,25 +424,31 @@ class FederatedCoordinator:
             # full params for workers whose cache missed the delta's base.
             body, resync_body, saved = self._downlink.encode_round(
                 r, params_np)
-        secure = self.config.fed.secure_agg
         cohort_ids = sorted(int(d.device_id) for d in cohort)
         reg = telemetry.get_registry()
 
-        def train_req():
+        def train_req(dev: DeviceInfo):
             req = protocol.attach_trace({"op": "train", "round": r}, ctx)
             if secure:
                 req["cohort"] = cohort_ids
+            if share_info is not None:
+                # This device's inbox of peer share ciphertexts rides the
+                # (per-device) request header; the broadcast body itself
+                # stays the shared serialize-once frame.
+                inbox = share_info["to"].get(dev.device_id)
+                if inbox:
+                    req["shares_in"] = inbox
             return req
 
         def ask(dev: DeviceInfo, deadline: float):
-            header, delta = self._request(dev, train_req(), body=body,
+            header, delta = self._request(dev, train_req(dev), body=body,
                                           deadline=deadline)
             if header.get("status") == "resync" and resync_body is not None:
                 # Cache miss on the worker (restart / skipped round): pay
                 # one full-params send for THIS device; the rest of the
                 # cohort keeps the compressed frame.
                 reg.counter("comm.resync_total").inc()
-                header, delta = self._request(dev, train_req(),
+                header, delta = self._request(dev, train_req(dev),
                                               body=resync_body(),
                                               deadline=deadline)
             elif saved:
@@ -398,8 +478,14 @@ class FederatedCoordinator:
 
         with self.tracer.span("broadcast_collect",
                               cohort=len(cohort)) as collect_sp:
-            results, failed = self._fan_out(cohort, ask, on_result=fold)
-        dropped = [d.device_id for d in failed]
+            # The train fan-out races what REMAINS of the round budget
+            # after the share phase — pruning late maskers must not
+            # stretch the round past its one deadline.
+            train_timeout = max(1.0, self.round_timeout
+                                - (time.monotonic() - round_t0))
+            results, failed = self._fan_out(cohort, ask, on_result=fold,
+                                            timeout=train_timeout)
+        dropped = pruned + [d.device_id for d in failed]
 
         with self.tracer.span("aggregate") as agg_sp:
             folder.finalize()
@@ -420,18 +506,28 @@ class FederatedCoordinator:
             # Aggregation quorum: a sub-quorum round is an explicit no-op
             # (the secure-agg discarded-round convention) rather than a
             # two-survivor average passed off as progress.  0 disables.
+            # Judged against the NOMINAL sampled cohort — share-phase
+            # pruning must not shrink the bar it is measured by.
             quorum = (max(1, math.ceil(self.min_cohort_fraction
-                                       * len(cohort)))
+                                       * len(cohort_full)))
                       if self.min_cohort_fraction > 0 else 0)
             skipped_quorum = bool(quorum) and folded < quorum
 
+            missing = sorted(set(cohort_ids) - set(received))
             unmask_failed = False
             if secure and folded and not skipped_quorum:
-                missing = sorted(set(cohort_ids) - set(received))
-                if missing:
-                    with self.tracer.span("unmask",
-                                          dropped=len(missing)):
-                        unmask_failed = not self._unmask_dropped(
+                if dh:
+                    # Share-based recovery runs EVERY dh round: folded
+                    # clients' self-masks must come off even when nobody
+                    # dropped (privacy/dropout.py double-mask).
+                    with self.tracer.span("unmask", dropped=len(missing)):
+                        unmask_failed = not self._recover_dh(
+                            r, cohort_ids, received, missing, folder,
+                            share_info
+                        )
+                elif missing:
+                    with self.tracer.span("unmask", dropped=len(missing)):
+                        unmask_failed = not self._recover_shared_seed(
                             r, cohort_ids, received, missing, folder
                         )
             mean_delta, total_w, mean_loss = folder.mean()
@@ -454,11 +550,11 @@ class FederatedCoordinator:
                 self.server_state = strategies.server_update(
                     self.server_state, mean_delta, self.config.fed
                 )
-        evicted = self._note_round_outcome(cohort, dropped)
+        evicted = self._note_round_outcome(cohort_full, dropped)
         rec = {
             "round": r,
             "completed": folded,
-            "cohort": len(cohort),
+            "cohort": len(cohort_full),
             "dropped": dropped,
             "evicted": evicted,
             "train_loss": mean_loss,
@@ -489,66 +585,264 @@ class FederatedCoordinator:
                 nominal = setup_lib.dp_effective_cohort(self.config)
                 sigma_eff = (self.config.fed.dp_noise_multiplier
                              * math.sqrt(min(folded, nominal) / nominal))
-                q = len(cohort) / max(1, len(self.trainers))
+                q = len(cohort_full) / max(1, len(self.trainers))
                 self.accountant.step(sampling_rate=q,
                                      noise_multiplier=sigma_eff)
             rec["dp_epsilon"] = self.accountant.epsilon()
             rec["dp_delta"] = self.accountant.delta
         return rec
 
-    def _unmask_dropped(self, r: int, cohort_ids, received, missing,
-                        folder) -> bool:
-        """Dropout-recovery round: every SURVIVOR returns the sum of the
-        pairwise masks it shared with the dropped peers; subtracting them
-        from the folded sum cancels the orphaned halves.  Returns False if
-        any survivor fails to answer (the round must then be discarded —
-        cascading recovery is out of scope for the honest-but-curious
-        demo).  Fans out with ONE shared deadline like the train phase
-        (sequential per-survivor timeouts would stack), and reconnects a
-        survivor whose unmask timed out so its late reply can't
-        desynchronise the next round's request/reply stream."""
-        from colearn_federated_learning_tpu.utils import pytrees
-
-        by_id = {int(d.device_id): d for d in self.trainers}
-        devs = []
-        for cid in received:
-            dev = by_id.get(cid)
-            if dev is None:
-                return False
-            devs.append(dev)
-
-        ctx = self.tracer.current_context()
+    def _share_phase(self, r: int, cohort, ctx):
+        """Collect every cohort member's encrypted recovery shares
+        (privacy/dropout.py) under the SHARE deadline (a fraction of the
+        round budget).  Returns ``(share_info, failed_devices)`` where
+        ``share_info`` routes each ciphertext to its destination's train
+        request and records each origin's reconstruction threshold and
+        self-mask commitment.  The coordinator relays ciphertexts it
+        cannot read — honest-but-curious stays honest-but-blind."""
+        cohort_ids = sorted(int(d.device_id) for d in cohort)
+        reg = telemetry.get_registry()
 
         def ask(dev: DeviceInfo, deadline: float):
-            header, mask = self._request(
+            header, _ = self._request(
                 dev,
                 protocol.attach_trace(
-                    {"op": "unmask", "round": r, "dropped": missing,
-                     "cohort": cohort_ids}, ctx),
+                    {"op": "share_setup", "round": r, "cohort": cohort_ids},
+                    ctx),
                 deadline=deadline,
             )
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
-            return header["meta"], mask
+            return header["meta"]
 
-        # Collect per device, then subtract in ``devs`` (= received) order:
-        # the float subtraction order must not depend on reply timing.
-        got: dict[str, tuple] = {}
+        got: dict[str, dict] = {}
+        share_timeout = max(1.0,
+                            self.round_timeout * self.share_timeout_fraction)
         _, failed = self._fan_out(
-            devs, ask, on_result=lambda dev, res: got.__setitem__(
-                dev.device_id, res))
-        for dev in devs:
-            res = got.get(dev.device_id)
-            if res is None:
-                continue
-            meta, mask = res
+            cohort, ask,
+            on_result=lambda dev, m: got.__setitem__(dev.device_id, m),
+            timeout=share_timeout)
+        info = {"t": {}, "commit": {}, "to": {}}
+        total = 0
+        for dev_id, meta in got.items():
             _pop_worker_spans(meta, self.tracer)
-            if int(meta.get("n_dropped_pairs", 0)) == 0 or mask is None:
-                continue
-            folder.wsum = pytrees.tree_sub(
-                folder.wsum, jax.tree.map(np.asarray, mask)
+            origin = str(meta.get("client_id", dev_id))
+            info["t"][origin] = int(meta.get("t", 0))
+            info["commit"][origin] = str(meta.get("b_commit", ""))
+            for dest, blob in (meta.get("shares") or {}).items():
+                info["to"].setdefault(str(dest), {})[origin] = blob
+                total += 1
+        if total:
+            reg.counter("privacy.shares_distributed_total").inc(total)
+        return info, failed
+
+    def _recover_dh(self, r: int, cohort_ids, received, missing,
+                    folder, share_info) -> bool:
+        """Share-based mask recovery (privacy/dropout.py, Bonawitz
+        pattern): collect t-of-n recovery shares from the folded
+        survivors, reconstruct every folded client's self-mask seed and
+        every dead client's session secret, and remove the lot — self
+        masks plus orphaned pair-mask halves — as ONE vectorized
+        correction term on the finalized fold.  Tolerates silent
+        survivors down to each origin's threshold; any reconstruction
+        short of its threshold is a HARD failure (returns False, the
+        round is discarded) because a sum with orphaned masks is garbage
+        that must never be released."""
+        import jax.numpy as jnp
+
+        from colearn_federated_learning_tpu.comm import enrollment
+        from colearn_federated_learning_tpu.comm import keyexchange
+        from colearn_federated_learning_tpu.privacy import dropout
+        from colearn_federated_learning_tpu.privacy import secure_agg as sa
+        from colearn_federated_learning_tpu.utils import prng
+
+        reg = telemetry.get_registry()
+
+        def fail(stage: str) -> bool:
+            reg.counter("privacy.share_recovery_failures_total",
+                        labels={"stage": stage}).inc()
+            return False
+
+        by_id = {int(d.device_id): d for d in self.trainers}
+        devs = [by_id[cid] for cid in received if cid in by_id]
+        # Folded clients that applied a self-mask this round (their share
+        # phase saw a nonempty recovery set).
+        alive_masked = [u for u in received
+                        if int(share_info["t"].get(str(u), 0)) > 0]
+        s_shares: dict = {y: {} for y in missing}
+        b_shares: dict = {u: {} for u in alive_masked}
+        b_direct: dict = {}       # folded clients revealing their OWN b
+        if missing or alive_masked:
+            ctx = self.tracer.current_context()
+
+            def ask(dev: DeviceInfo, deadline: float):
+                header, _ = self._request(
+                    dev,
+                    protocol.attach_trace(
+                        {"op": "unmask", "round": r, "dropped": missing,
+                         "alive": alive_masked}, ctx),
+                    deadline=deadline,
+                )
+                if header.get("status") != "ok":
+                    raise RuntimeError(
+                        f"{dev.device_id}: {header.get('error')}")
+                return header["meta"]
+
+            got: dict[str, dict] = {}
+            self._fan_out(devs, ask,
+                          on_result=lambda dev, m: got.__setitem__(
+                              dev.device_id, m))
+            collected = 0
+            for dev in devs:
+                meta = got.get(dev.device_id)
+                if meta is None:
+                    continue    # t-of-n: silent survivors are tolerated
+                _pop_worker_spans(meta, self.tracer)
+                x = int(meta["client_id"]) + 1
+                for origin, val in (meta.get("s_shares") or {}).items():
+                    if int(origin) in s_shares:
+                        s_shares[int(origin)][x] = int(val, 16)
+                        collected += 1
+                for origin, val in (meta.get("b_shares") or {}).items():
+                    if int(origin) in b_shares:
+                        b_shares[int(origin)][x] = int(val, 16)
+                        collected += 1
+                if meta.get("b_self") is not None and (
+                        int(meta["client_id"]) in b_shares):
+                    # A folded survivor may reveal its own self-mask seed
+                    # directly — security-equivalent to the t-of-n path
+                    # for an ALIVE client (its peers would reconstruct the
+                    # same value), and the only recovery when every
+                    # share-holder was pruned before the shares shipped.
+                    b_direct[int(meta["client_id"])] = int(
+                        meta["b_self"], 16)
+                    collected += 1
+            reg.counter("privacy.shares_collected_total").inc(collected)
+
+        keys: list = []
+        signs: list = []
+        # Self-mask removal for every folded client.
+        for u in alive_masked:
+            t_u = int(share_info["t"][str(u)])
+            try:
+                b = (b_direct[u] if u in b_direct
+                     else dropout.reconstruct(b_shares.get(u, {}), t_u))
+            except dropout.RecoveryError:
+                return fail("self_mask")
+            if dropout.commitment(b) != share_info["commit"].get(str(u)):
+                # Enough shares arrived but they interpolate to the wrong
+                # seed (corrupt share / inconsistent stash): subtracting a
+                # garbage self-mask would corrupt the aggregate silently.
+                return fail("self_mask_commit")
+            keys.append(dropout.self_mask_key(b))
+            signs.append(1.0)
+        if alive_masked:
+            reg.counter("privacy.self_masks_removed_total").inc(
+                len(alive_masked))
+        # Orphaned pair-mask halves of the dead: reconstruct each dead
+        # client's session secret, verify it against its published DH key,
+        # and re-derive the pair keys it shared with every folded partner.
+        if missing:
+            base_key = prng.experiment_key(self.config.run.seed)
+            table = np.asarray(sa.partner_table(
+                base_key, jnp.asarray(missing, jnp.int32),
+                jnp.asarray(cohort_ids, jnp.int32),
+                jnp.asarray(r, jnp.int32),
+                neighbors=self.config.fed.secure_agg_neighbors,
+            ))
+            folded_set = set(received)
+            info_cache: dict = {}
+            for y, row in zip(missing, table):
+                t_y = share_info["t"].get(str(y))
+                if t_y is None:
+                    return fail("no_share_setup")
+                try:
+                    s_y = dropout.reconstruct(s_shares.get(y, {}), int(t_y))
+                except dropout.RecoveryError:
+                    return fail("session_secret")
+                try:
+                    pub_y = keyexchange.decode_public(
+                        enrollment.fetch_device_info(
+                            self._broker, str(y), cache=info_cache).pubkey)
+                except (OSError, TimeoutError, ValueError):
+                    return fail("pubkey_lookup")
+                if pow(keyexchange.GROUP14_G, s_y,
+                       keyexchange.GROUP14_P) != pub_y:
+                    # Wrong interpolation (or a tampered share): the
+                    # public key is the binding check for session secrets.
+                    return fail("session_secret_verify")
+                partners = sorted(
+                    ({int(p) for p in row.tolist()} & folded_set) - {y})
+                for v in partners:
+                    try:
+                        pub_v = keyexchange.decode_public(
+                            enrollment.fetch_device_info(
+                                self._broker, str(v),
+                                cache=info_cache).pubkey)
+                    except (OSError, TimeoutError, ValueError):
+                        return fail("pubkey_lookup")
+                    secret = keyexchange.shared_secret(s_y, pub_v)
+                    keys.append(np.asarray(
+                        keyexchange.pair_prng_key(secret, v, y)))
+                    # Survivor v folded sign(y − v)·PRG(k_vy); subtract
+                    # exactly that.
+                    signs.append(1.0 if y > v else -1.0)
+                reg.counter("privacy.masks_recovered_total",
+                            labels={"device": str(y)}).inc()
+        if keys:
+            template = jax.tree.map(
+                lambda l: jnp.zeros(np.shape(l), jnp.float32), folder.shapes)
+            correction = sa.pairwise_mask_with_keys(
+                template, jnp.asarray(np.stack(keys)),
+                jnp.asarray(np.asarray(signs, np.float32)),
+                jnp.asarray(r, jnp.int32),
             )
-        return not failed
+            folder.apply_correction(jax.tree.map(np.asarray, correction))
+        return True
+
+    def _recover_shared_seed(self, r: int, cohort_ids, received, missing,
+                             folder) -> bool:
+        """Dropout recovery under the coordinator-trusted ``shared_seed``
+        exchange: every pair key derives from the experiment seed this
+        process already holds, so the orphaned halves are recomputed
+        LOCALLY — zero survivor round-trips, immune to further survivor
+        deaths.  (The privacy trade-off is the mode's, not recovery's:
+        see FedConfig.secure_agg_key_exchange.)"""
+        import jax.numpy as jnp
+
+        from colearn_federated_learning_tpu.privacy import secure_agg as sa
+        from colearn_federated_learning_tpu.utils import prng, pytrees
+
+        reg = telemetry.get_registry()
+        base_key = prng.experiment_key(self.config.run.seed)
+        table = np.asarray(sa.partner_table(
+            base_key, jnp.asarray(missing, jnp.int32),
+            jnp.asarray(cohort_ids, jnp.int32), jnp.asarray(r, jnp.int32),
+            neighbors=self.config.fed.secure_agg_neighbors,
+        ))
+        folded_set = set(received)
+        template = jax.tree.map(
+            lambda l: jnp.zeros(np.shape(l), jnp.float32), folder.shapes)
+        correction = None
+        for y, row in zip(missing, table):
+            partners = sorted({int(p) for p in row.tolist()} & folded_set)
+            if not partners:
+                continue
+            # The mask y WOULD have added is the exact negative of its
+            # orphaned halves in the folded sum (sign antisymmetry).
+            mask_y = sa.pairwise_mask(
+                template, base_key, jnp.asarray(y, jnp.int32),
+                jnp.asarray(partners, jnp.int32),
+                jnp.asarray(r, jnp.int32),
+            )
+            neg = pytrees.tree_scale(jax.tree.map(np.asarray, mask_y), -1.0)
+            correction = (neg if correction is None
+                          else pytrees.tree_add(correction, neg))
+            reg.counter("privacy.masks_recovered_total",
+                        labels={"device": str(y)}).inc()
+        if correction is not None:
+            folder.apply_correction(correction)
+        return True
 
     def evaluate_per_client(self) -> dict:
         """Score the CURRENT global model on every trainer's own shard

@@ -15,9 +15,9 @@ masked updates only.
 
 Construction: finite-field DH over the RFC 3526 group-14 2048-bit MODP
 prime (stdlib-only: ``pow(g, x, p)`` + SHA-256), 512-bit exponents.  The
-prime is a safe prime, so the subgroup checks reduce to the range check
-in :func:`validate_public` (1 < pub < p-1 excludes the order-1/2
-elements).  Pair key: SHA-256(secret ‖ context-tag ‖ sorted pair ids) →
+prime is a safe prime, so the only small-subgroup elements are {0, 1,
+p-1}; :func:`validate_public` rejects each by name (plus the range
+check) and counts rejections under ``comm.keyexchange_rejected_total``.  Pair key: SHA-256(secret ‖ context-tag ‖ sorted pair ids) →
 64-bit PRNG seed; the round index is folded in on-device so one exchange
 covers every round.
 
@@ -60,11 +60,43 @@ def generate_keypair() -> tuple[int, int]:
     return priv, pow(GROUP14_G, priv, GROUP14_P)
 
 
+class InvalidPublicKeyError(ValueError):
+    """A peer published a degenerate or out-of-range DH public value.
+    Subclasses ValueError so existing ``except ValueError`` call sites
+    keep working; carries the rejection ``reason`` label."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        super().__init__(f"invalid DH public key ({reason})")
+
+
+def _reject(reason: str) -> "InvalidPublicKeyError":
+    # Lazy import: keyexchange must stay importable without dragging the
+    # telemetry plane in at module load (mirrors protocol.py's pattern).
+    from colearn_federated_learning_tpu import telemetry
+
+    telemetry.get_registry().counter(
+        "comm.keyexchange_rejected_total", labels={"reason": reason}
+    ).inc()
+    return InvalidPublicKeyError(reason)
+
+
 def validate_public(pub: int) -> int:
-    """Reject degenerate public values (0, 1, p-1 — the order-1/2
-    elements of the safe-prime group — and anything out of range)."""
+    """Reject degenerate public values with a dedicated error and a
+    labeled rejection counter.  In a safe-prime group the small-subgroup
+    elements are exactly {0, 1, p-1} (orders —, 1, 2): a peer publishing
+    one would force the pair's shared secret into a guessable set, letting
+    a curious relay unmask that pair's stream, so each is named rather
+    than lumped into the range check."""
+    pub = int(pub)
+    if pub == 0:
+        raise _reject("zero")
+    if pub == 1:
+        raise _reject("identity")
+    if pub == GROUP14_P - 1:
+        raise _reject("order_two")
     if not 1 < pub < GROUP14_P - 1:
-        raise ValueError("invalid DH public key (out of range)")
+        raise _reject("out_of_range")
     return pub
 
 

@@ -11,6 +11,14 @@ Every frame carries a CRC32 over header+body, so a corrupted frame is a
 device's bad frame drops that device, never the coordinator) instead of
 surfacing as a JSON decode error or, worse, silently folding garbage
 bytes into an aggregate.
+
+The secure-aggregation dropout protocol (privacy/dropout.py) rides this
+framing untouched: ``share_setup`` / ``unmask`` requests are header-only
+frames (no tensor body), and the per-device encrypted share blobs travel
+as hex strings in the JSON header (``shares_in``) — which is what lets
+the broadcast body stay a single shared serialize-once buffer.  At 132
+bytes (264 hex chars) per share, ``MAX_HEADER`` comfortably bounds the
+per-device share inbox for any socket-plane cohort.
 """
 
 from __future__ import annotations

@@ -9,12 +9,24 @@ vmaps), serves ``train`` / ``eval`` requests over the tensor plane, and
 enrolls itself on the control plane.
 
 Requests:
-  {"op": "train", "round": r[, "cohort"]} + params → delta + meta{weight,...}
+  {"op": "train", "round": r[, "cohort"][, "shares_in"]} + params
+                                       →  delta + meta{weight,...}
+  {"op": "share_setup", "round", "cohort"} → meta{shares, t, b_commit}:
+                                           this round's Shamir shares of the
+                                           session DH secret + a fresh
+                                           self-mask seed, one ciphertext
+                                           per recovery-set peer (relayed
+                                           opaquely by the coordinator)
   {"op": "eval"}      + global params  →  meta{eval_loss, eval_acc}
   {"op": "self_eval"} + global params  →  meta{self_loss, self_acc, ...}
                                            (disabled under secure_agg)
-  {"op": "unmask", "round", "dropped", "cohort"} → summed pair masks vs the
-                                           dropped peers (dropout recovery)
+  {"op": "unmask", "round", "dropped", "alive"} → recovery shares: session-
+                                           secret shares for the dead,
+                                           self-mask shares for the folded
+                                           (never both per origin)
+  {"op": "unmask", "round", "dropped", "cohort"} → legacy direct form:
+                                           summed pair masks vs the dropped
+                                           peers this client paired with
   {"op": "info"}                       →  meta{num_examples, ...}
 """
 
@@ -81,6 +93,13 @@ class DeviceWorker:
                 "secure_agg_key_exchange must be 'dh' or 'shared_seed', "
                 f"got {c.fed.secure_agg_key_exchange!r}"
             )
+        if c.fed.secure_agg and not (
+            0.0 < c.fed.secure_agg_threshold <= 1.0
+        ):
+            raise ValueError(
+                "secure_agg_threshold must be in (0, 1], got "
+                f"{c.fed.secure_agg_threshold}"
+            )
         self._dh_mode = (c.fed.secure_agg
                          and c.fed.secure_agg_key_exchange == "dh")
         if self._dh_mode:
@@ -98,8 +117,18 @@ class DeviceWorker:
             self._dh_lookup = None        # dedicated broker connection
             self._dh_stopped = False
             self._peer_info_cache: dict = {}   # cleared each round
-            self._peer_keys: dict = {}    # id -> (pubkey_str, key uint32[2])
+            # id -> (pubkey_str, pair key uint32[2], raw DH secret bytes)
+            self._peer_keys: dict = {}
             self._peer_round: Optional[int] = None
+            # Dropout-recovery state (privacy/dropout.py): per-round
+            # self-mask seeds, decrypted incoming shares keyed
+            # (round, origin), and the reveal-exclusivity ledger — at most
+            # ONE of {self-mask share, session-secret share} is ever
+            # revealed per (round, origin), or the coordinator could
+            # unmask a folded client it falsely reported dead.
+            self._round_secrets: dict = {}     # round -> b_u | None
+            self._incoming_shares: dict = {}   # (round, origin) -> (s, b)
+            self._revealed: dict = {}          # (round, origin) -> "s"|"b"
 
         ds = dataset or data_registry.get_dataset(c.data.dataset,
                                                   seed=c.run.seed)
@@ -287,8 +316,18 @@ class DeviceWorker:
         if op == "train":
             return self._train(int(header.get("round", 0)), tree,
                                cohort=header.get("cohort"),
-                               meta=header.get("meta"))
+                               meta=header.get("meta"),
+                               shares_in=header.get("shares_in"))
+        if op == "share_setup":
+            return self._share_setup(int(header.get("round", 0)),
+                                     header.get("cohort", []))
         if op == "unmask":
+            if "alive" in header:
+                # Share-based recovery (privacy/dropout.py); the legacy
+                # header shape below keeps the direct mask-sum semantics.
+                return self._unmask_shares(int(header.get("round", 0)),
+                                           header.get("dropped", []),
+                                           header.get("alive", []))
             return self._unmask(int(header.get("round", 0)),
                                 header.get("dropped", []),
                                 header.get("cohort", []), tree)
@@ -329,19 +368,7 @@ class DeviceWorker:
         key actually changed.  Runs on a DEDICATED broker connection —
         sharing the enrollment client's single message queue would race
         ``await_role`` and other concurrent train requests."""
-        from colearn_federated_learning_tpu.comm import keyexchange
-        from colearn_federated_learning_tpu.comm.broker import BrokerClient
-
         with self._dh_lock:
-            if self._dh_stopped:
-                raise RuntimeError("worker is stopped")
-            if self._dh_lookup is None:
-                bh, bp = self._broker_addr
-                self._dh_lookup = BrokerClient(
-                    bh, bp, timeout=protocol.CONNECT_TIMEOUT)
-            if self._peer_round != round_idx:
-                self._peer_info_cache.clear()
-                self._peer_round = round_idx
             keys, signs = [], []
             for p in np.asarray(partner_ids).tolist():
                 p = int(p)
@@ -349,29 +376,164 @@ class DeviceWorker:
                     keys.append(np.zeros(2, np.uint32))  # self-pair: sign 0
                     signs.append(0.0)
                     continue
-                info = enrollment.fetch_device_info(
-                    self._dh_lookup, str(p), cache=self._peer_info_cache
-                )
-                if not info.pubkey:
-                    raise RuntimeError(
-                        f"peer {p} enrolled without a DH public key; all "
-                        "cohort members must run secure_agg_key_exchange="
-                        "'dh'"
-                    )
-                cached = self._peer_keys.get(p)
-                if cached is None or cached[0] != info.pubkey:
-                    secret = keyexchange.shared_secret(
-                        self._dh_priv,
-                        keyexchange.decode_public(info.pubkey),
-                    )
-                    cached = (info.pubkey, np.asarray(
-                        keyexchange.pair_prng_key(secret, self.client_id, p)
-                    ))
-                    self._peer_keys[p] = cached
-                keys.append(cached[1])
+                keys.append(self._peer_record(p, round_idx)[1])
                 signs.append(1.0 if p > self.client_id else -1.0)
         return (jnp.asarray(np.stack(keys)),
                 jnp.asarray(np.asarray(signs, np.float32)))
+
+    def _peer_record(self, p: int, round_idx: int) -> tuple:
+        """(pubkey_str, pair PRNG key uint32[2], raw DH secret bytes) for
+        peer ``p``.  Caller holds ``_dh_lock``.  The secret bytes feed the
+        share-transport keystream (privacy/dropout.py) so recovery shares
+        relayed through the coordinator stay opaque to it."""
+        from colearn_federated_learning_tpu.comm import keyexchange
+
+        if self._dh_stopped:
+            raise RuntimeError("worker is stopped")
+        if self._dh_lookup is None:
+            bh, bp = self._broker_addr
+            self._dh_lookup = BrokerClient(
+                bh, bp, timeout=protocol.CONNECT_TIMEOUT)
+        if self._peer_round != round_idx:
+            self._peer_info_cache.clear()
+            self._peer_round = round_idx
+        info = enrollment.fetch_device_info(
+            self._dh_lookup, str(p), cache=self._peer_info_cache
+        )
+        if not info.pubkey:
+            raise RuntimeError(
+                f"peer {p} enrolled without a DH public key; all "
+                "cohort members must run secure_agg_key_exchange='dh'"
+            )
+        cached = self._peer_keys.get(p)
+        if cached is None or cached[0] != info.pubkey:
+            secret = keyexchange.shared_secret(
+                self._dh_priv,
+                keyexchange.decode_public(info.pubkey),
+            )
+            cached = (info.pubkey, np.asarray(
+                keyexchange.pair_prng_key(secret, self.client_id, p)
+            ), secret)
+            self._peer_keys[p] = cached
+        return cached
+
+    def _recovery_set(self, round_idx: int, cohort: list) -> list:
+        """Distinct non-self partner ids for the round — the Shamir
+        shareholders.  Ring mode: the 2·neighbors ring peers; complete
+        mode: everyone else in the cohort (or the GROUP under the
+        hierarchical plane, which runs one federation per group)."""
+        row = np.asarray(self._partner_row(round_idx, cohort)).tolist()
+        return sorted({int(p) for p in row} - {self.client_id})
+
+    def _share_setup(self, round_idx: int, cohort: list) -> tuple[dict, Any]:
+        """Phase 1 of the dropout-tolerant secure round
+        (privacy/dropout.py): mint this round's self-mask seed and
+        Shamir-share it — together with the session DH secret — across the
+        recovery set, one ciphertext per shareholder that only that peer
+        can open.  The coordinator relays the ciphertexts on the train
+        broadcast; a later ``unmask`` collects them back t-of-n."""
+        if not self.config.fed.secure_agg:
+            return ({"status": "error",
+                     "error": "share_setup requires secure_agg"}, None)
+        if not self._dh_mode:
+            # shared_seed: the coordinator already knows every pair key
+            # and recovers dropouts locally — nothing to distribute.
+            return ({"meta": {"client_id": self.client_id, "shares": {},
+                              "t": 0, "b_commit": ""}}, None)
+        from colearn_federated_learning_tpu.privacy import dropout
+
+        rs = self._recovery_set(round_idx, cohort)
+        if not rs:
+            # Solo cohort: no shareholders, hence no removable self-mask —
+            # so none is applied either (see _train).
+            self._store_round_secret(round_idx, None)
+            return ({"meta": {"client_id": self.client_id, "shares": {},
+                              "t": 0, "b_commit": ""}}, None)
+        t = dropout.threshold_count(
+            len(rs), self.config.fed.secure_agg_threshold)
+        b = dropout.random_secret()
+        xs = [p + 1 for p in rs]
+        s_shares = dropout.split_secret(self._dh_priv, xs, t)
+        b_shares = dropout.split_secret(b, xs, t)
+        shares = {}
+        with self._dh_lock:
+            for p in rs:
+                secret = self._peer_record(p, round_idx)[2]
+                shares[str(p)] = dropout.encrypt_share(
+                    secret, self.client_id, p, round_idx,
+                    s_shares[p + 1], b_shares[p + 1],
+                )
+        self._store_round_secret(round_idx, b)
+        return ({"meta": {"client_id": self.client_id, "shares": shares,
+                          "t": t, "b_commit": dropout.commitment(b)}}, None)
+
+    def _store_round_secret(self, round_idx: int, b) -> None:
+        """Remember the round's self-mask seed; expire old rounds (the
+        secrets and stashed shares are per-round, so a long-lived worker
+        must not accumulate them forever)."""
+        self._round_secrets[round_idx] = b
+        cutoff = round_idx - 16
+        if any(r < cutoff for r in self._round_secrets):
+            self._round_secrets = {r: v for r, v in
+                                   self._round_secrets.items() if r >= cutoff}
+            self._incoming_shares = {k: v for k, v in
+                                     self._incoming_shares.items()
+                                     if k[0] >= cutoff}
+            self._revealed = {k: v for k, v in self._revealed.items()
+                              if k[0] >= cutoff}
+
+    def _stash_shares(self, round_idx: int, shares_in: dict) -> None:
+        """Decrypt and stash the round's incoming recovery shares (one
+        ciphertext per origin, relayed opaquely by the coordinator)."""
+        from colearn_federated_learning_tpu.privacy import dropout
+
+        with self._dh_lock:
+            for origin, blob in shares_in.items():
+                o = int(origin)
+                if o == self.client_id:
+                    continue
+                secret = self._peer_record(o, round_idx)[2]
+                self._incoming_shares[(round_idx, o)] = dropout.decrypt_share(
+                    secret, o, self.client_id, round_idx, blob)
+
+    def _unmask_shares(self, round_idx: int, dropped: list,
+                       alive: list) -> tuple[dict, Any]:
+        """Share-based dropout recovery: reveal the SELF-MASK share for
+        origins whose masked update folded and the SESSION-SECRET share
+        for origins reported dead — never both for one (round, origin),
+        enforced by a persistent ledger (revealing both would hand the
+        coordinator a folded client's bare update)."""
+        s_out: dict = {}
+        b_out: dict = {}
+        reply: dict = {"client_id": self.client_id,
+                       "s_shares": s_out, "b_shares": b_out}
+        for kind, ids, out in (("s", dropped, s_out), ("b", alive, b_out)):
+            for o in ids:
+                o = int(o)
+                if o == self.client_id:
+                    # Own session secret is NEVER revealed.  Own self-mask
+                    # seed MAY be, once this round's update has folded —
+                    # revealing b_u for an alive u is exactly what the
+                    # share path reconstructs anyway, and it is the only
+                    # recovery when every share-holder was pruned (n=2
+                    # with the lone peer down).  Ledger still applies.
+                    if kind == "b":
+                        b = self._round_secrets.get(round_idx)
+                        prior = self._revealed.get((round_idx, o))
+                        if b is not None and prior in (None, "b"):
+                            self._revealed[(round_idx, o)] = "b"
+                            reply["b_self"] = format(b, "x")
+                    continue
+                stash = self._incoming_shares.get((round_idx, o))
+                if stash is None:
+                    continue
+                prior = self._revealed.get((round_idx, o))
+                if prior is not None and prior != kind:
+                    continue      # exclusivity: refuse the second kind
+                self._revealed[(round_idx, o)] = kind
+                out[str(o)] = format(stash[0] if kind == "s" else stash[1],
+                                     "x")
+        return ({"meta": reply}, None)
 
     def _resolve_params(self, round_idx: int, meta: Optional[dict],
                         tree: Any) -> Any:
@@ -390,7 +552,7 @@ class DeviceWorker:
         return self._param_cache.resolve(round_idx, meta or {}, tree)
 
     def _train(self, round_idx: int, global_params: Any,
-               cohort=None, meta=None) -> tuple[dict, Any]:
+               cohort=None, meta=None, shares_in=None) -> tuple[dict, Any]:
         with self.tracer.span("deserialize_params"):
             full = self._resolve_params(round_idx, meta, global_params)
             if full is None:
@@ -425,6 +587,10 @@ class DeviceWorker:
             # the engine's secure path.
             from colearn_federated_learning_tpu.privacy import secure_agg as sa
 
+            if self._dh_mode and shares_in:
+                # Peers' recovery-share ciphertexts ride the train request;
+                # stash them decrypted so a later unmask can answer t-of-n.
+                self._stash_shares(round_idx, shares_in)
             with self.tracer.span("secure_mask", dh=self._dh_mode):
                 delta_f32 = jax.tree.map(
                     lambda l: l.astype(jnp.float32), delta
@@ -436,6 +602,23 @@ class DeviceWorker:
                         delta_f32, pair_keys, signs,
                         jnp.asarray(round_idx, jnp.int32),
                     )
+                    b = self._round_secrets.get(round_idx)
+                    if b is not None:
+                        # Double-mask: the self-mask rides ONLY when this
+                        # round's share_setup distributed its removal
+                        # shares — an unremovable self-mask would poison
+                        # the aggregate (and a raw train request without a
+                        # share phase keeps the legacy single-mask wire).
+                        from colearn_federated_learning_tpu.privacy import (
+                            dropout,
+                        )
+
+                        delta = sa.mask_update_with_keys(
+                            delta,
+                            jnp.asarray(dropout.self_mask_key(b))[None, :],
+                            jnp.ones(1, jnp.float32),
+                            jnp.asarray(round_idx, jnp.int32),
+                        )
                 else:
                     delta = sa.mask_update(
                         delta_f32, self._key,

@@ -11,7 +11,12 @@ is a regression test, not a dice roll.
 
 Comm-plane kinds (applied by :mod:`.inject` at the transport seams):
 ``drop_request``, ``delay``, ``corrupt_payload``, ``crash_worker``,
-``flap_reconnect``.  File/hierarchical-plane kinds (applied by
+``flap_reconnect``.  The ``op`` key matches whatever the request header
+carries, so secure-aggregation rounds expose two extra drop points:
+``op="share_setup"`` (device pruned before training — no recovery
+needed) and ``op="unmask"`` (device folds its masked update, then goes
+silent DURING recovery — the after-fold/before-unmask window the
+dropout protocol exists for).  File/hierarchical-plane kinds (applied by
 :mod:`.fileplane`, keyed ``(silo|group, round, hop)``):
 
 - ``truncate_file`` — an update npz is cut short mid-write (killed silo);

@@ -8,7 +8,9 @@ frames, torn files and lost process state.  It spawns the broker, N
 sockets, then delivers ``SIGKILL`` on a deterministic schedule keyed by
 round — including to the coordinator mid-round, which must come back with
 ``--resume`` and finish the original round budget from its checkpoint +
-round WAL.
+round WAL, and to the broker, which is respawned on its original port
+and must be healed INTO by the survivors (worker re-enrollment
+watchdogs, coordinator ``_rebuild_broker``) without losing a round.
 
 The schedule is event-driven, not timer-driven: a :class:`KillSpec`
 fires the moment the coordinator's stderr emits the round record for
@@ -31,6 +33,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 from typing import Callable, Optional
 
 _CLI = "colearn_federated_learning_tpu.cli"
@@ -40,30 +43,34 @@ _CLI = "colearn_federated_learning_tpu.cli"
 class KillSpec:
     """One scheduled SIGKILL.
 
-    ``target`` is ``"coordinator"`` or ``"worker:<client_id>"``.  The
-    signal is sent as soon as the round record for ``after_round``
-    appears, i.e. it lands mid-round ``after_round + 1``.  ``restart``
-    respawns the victim: a worker re-announces on a fresh port (and is
-    re-admitted by the elastic coordinator after eviction), the
-    coordinator comes back with ``--resume``."""
+    ``target`` is ``"coordinator"``, ``"broker"`` or
+    ``"worker:<client_id>"``.  The signal is sent as soon as the round
+    record for ``after_round`` appears, i.e. it lands mid-round
+    ``after_round + 1``.  ``restart`` respawns the victim: a worker
+    re-announces on a fresh port (and is re-admitted by the elastic
+    coordinator after eviction), the coordinator comes back with
+    ``--resume``, and the broker rebinds its ORIGINAL port — the
+    control-plane SPOF heals through the worker re-enrollment watchdog
+    and the coordinator's ``_rebuild_broker`` without any address
+    change."""
 
     target: str
     after_round: int
     restart: bool = True
 
     def __post_init__(self):
-        if self.target != "coordinator" and not (
+        if self.target not in ("coordinator", "broker") and not (
                 self.target.startswith("worker:")
                 and self.target.split(":", 1)[1].isdigit()):
             raise ValueError(
-                f"target must be 'coordinator' or 'worker:<id>', "
-                f"got {self.target!r}")
+                f"target must be 'coordinator', 'broker' or "
+                f"'worker:<id>', got {self.target!r}")
         if self.after_round < 0:
             raise ValueError(
                 f"after_round must be >= 0, got {self.after_round}")
-        if self.target == "coordinator" and not self.restart:
+        if self.target in ("coordinator", "broker") and not self.restart:
             raise ValueError(
-                "killing the coordinator without restart ends the "
+                f"killing the {self.target} without restart ends the "
                 "federation; use restart=True")
 
 
@@ -74,13 +81,20 @@ def canned_kill_schedule(rounds: int, n_workers: int) -> list[KillSpec]:
       elastic re-admission on a fresh port) — only when the run is long
       enough for it to be evicted AND re-converge;
     - the coordinator dies mid-round ``rounds // 2 + 1``, after the
-      round-``rounds//2`` checkpoint committed, and must resume.
+      round-``rounds//2`` checkpoint committed, and must resume;
+    - the broker dies one round after the coordinator resumed and
+      rebinds its original port (control-plane SPOF: worker watchdogs
+      re-enroll, the coordinator rebuilds its client) — only when the
+      run leaves at least one full round after the rebind to prove the
+      federation still commits.
     """
     kills = []
     if rounds >= 5 and n_workers >= 3:
         kills.append(KillSpec("worker:1", after_round=1))
     kills.append(KillSpec("coordinator",
                           after_round=max(0, rounds // 2 - 1)))
+    if rounds >= 4:
+        kills.append(KillSpec("broker", after_round=rounds // 2))
     return kills
 
 
@@ -131,9 +145,11 @@ class _Fleet:
         return subprocess.Popen([sys.executable, "-m", _CLI, *args],
                                 env=self.env, **kw)
 
-    def start_broker(self, timeout: float) -> tuple[str, int]:
+    def start_broker(self, timeout: float,
+                     extra: list[str] = ()) -> tuple[str, int]:
+        self._broker_extra = list(extra)
         self.broker = self.spawn(
-            ["broker"], stdout=subprocess.PIPE,
+            ["broker", *self._broker_extra], stdout=subprocess.PIPE,
             stderr=self._log_file("broker.log"), text=True)
         ready, _, _ = select.select([self.broker.stdout], [], [], timeout)
         if not ready:
@@ -141,7 +157,39 @@ class _Fleet:
         doc = _parse_json(self.broker.stdout.readline())
         if not doc:
             raise RuntimeError("broker printed no address line")
-        return doc["host"], int(doc["port"])
+        self._broker_addr = (doc["host"], int(doc["port"]))
+        return self._broker_addr
+
+    def restart_broker(self, timeout: float = 15.0,
+                       attempts: int = 20) -> None:
+        """Respawn the broker bound to its ORIGINAL host:port.
+
+        Workers and the coordinator hold that address — the heal paths
+        (worker re-enrollment watchdog, coordinator ``_rebuild_broker``)
+        reconnect, they do not rediscover.  The listener socket dies
+        with the SIGKILLed process, but the kernel may briefly hold the
+        port through lingering accepted connections, so the rebind
+        retries with a short sleep instead of failing the soak on a
+        race the real deployment would also just retry through."""
+        host, port = self._broker_addr
+        for _ in range(attempts):
+            self.broker = self.spawn(
+                ["broker", "--host", host, "--port", str(port),
+                 *self._broker_extra],
+                stdout=subprocess.PIPE,
+                stderr=self._log_file("broker.log"), text=True)
+            ready, _, _ = select.select([self.broker.stdout], [], [],
+                                        timeout)
+            if ready:
+                doc = _parse_json(self.broker.stdout.readline())
+                if doc and int(doc["port"]) == port:
+                    return
+            if self.broker.poll() is None:
+                self.broker.kill()
+            self.broker.wait()
+            time.sleep(0.25)
+        raise RuntimeError(f"broker failed to rebind {host}:{port} "
+                           f"after {attempts} attempts")
 
     def start_worker(self, client_id: int, cfg: list[str], host: str,
                      port: int) -> None:
@@ -240,12 +288,14 @@ def run_proc_soak(
 
     try:
         watchdog.start()
-        host, port = fleet.start_broker(timeout=30.0)
         # Every process flies with the black box on a fast heartbeat: a
         # SIGKILL is uncatchable, so the per-kill dump the summary
-        # asserts below IS the victim's last heartbeat rewrite.
+        # asserts below IS the victim's last heartbeat rewrite.  The
+        # broker carries it too — a broker KillSpec's pid must show up
+        # in the flight ledger like any other victim's.
         flight_flags = ["--flight-dir", flight_dir,
                         "--flight-heartbeat", "0.5"]
+        host, port = fleet.start_broker(timeout=30.0, extra=flight_flags)
         worker_cfg = _config_flags(rounds, n_workers, seed) + flight_flags
         for i in range(n_workers):
             fleet.start_worker(i, worker_cfg, host, port)
@@ -259,8 +309,15 @@ def run_proc_soak(
 
         coord = launch(resume=False)
         restart_pending = False
+        # Mirror the coordinator's stderr to a workdir log: the harness
+        # parses JSON records off the stream, but a crash traceback is
+        # NOT JSON and would otherwise vanish with the pipe.
+        err_log = fleet._log_file("coordinator.err")
         while True:
             line = coord.stderr.readline()
+            if line:
+                err_log.write(line.encode())
+                err_log.flush()
             if not line:
                 coord.wait()
                 if restart_pending:
@@ -295,6 +352,13 @@ def run_proc_soak(
                     kill_rec["pid"] = coord.pid
                     coord.send_signal(signal.SIGKILL)
                     restart_pending = True
+                elif spec.target == "broker":
+                    victim = fleet.broker
+                    if victim is not None and victim.poll() is None:
+                        kill_rec["pid"] = victim.pid
+                        victim.send_signal(signal.SIGKILL)
+                        victim.wait()
+                    fleet.restart_broker()
                 else:
                     wid = int(spec.target.split(":", 1)[1])
                     victim = fleet.workers.get(wid)

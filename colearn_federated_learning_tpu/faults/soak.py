@@ -28,6 +28,7 @@ from colearn_federated_learning_tpu.utils.config import (
 # Counters whose soak-window delta the summary reports — declared once in
 # the metric catalog so this gate and CL005 can never drift apart.
 from colearn_federated_learning_tpu.analysis.metric_catalog import (
+    SECURE_SOAK_DELTA_COUNTERS as _SECURE_COUNTERS,
     SOAK_DELTA_COUNTERS as _COUNTERS,
 )
 
@@ -192,6 +193,211 @@ def run_soak(rounds: int = 10, n_workers: int = 4,
              and (delta := v - labeled_before.get(k, 0)) > 0),
             key=lambda t: (-t["count"], t["label"])),
         "faults_fired": dict(plan.fired) if plan is not None else {},
+    }
+
+
+# ------------------------------------------------------- secure flavor --
+def secure_soak_config(n_workers: int = 5, seed: int = 0,
+                       comm_retries: int = 2) -> ExperimentConfig:
+    """Soak config with DH secure aggregation ON.
+
+    Five workers is the floor for the combined-drop round of
+    :func:`canned_secure_plan`: with one trainer dead and one masker
+    silent during recovery, each origin's threshold t = ceil(0.5·4) = 2
+    still has 3 reachable share-holders; at n=4 the same round leaves
+    exactly t survivors with zero slack, and any retry hiccup flips the
+    gate from "recovered exactly" to "correctly discarded" — a flake,
+    not a verdict.  ``max_examples_per_client`` caps the per-round work
+    so the lockstep twin-federation run stays CI-sized."""
+    import dataclasses
+
+    cfg = default_soak_config(n_workers, seed=seed,
+                              comm_retries=comm_retries)
+    return cfg.replace(
+        data=dataclasses.replace(cfg.data, max_examples_per_client=64),
+        fed=dataclasses.replace(cfg.fed, secure_agg=True,
+                                secure_agg_key_exchange="dh",
+                                secure_agg_threshold=0.5),
+        run=dataclasses.replace(cfg.run, name="secure_soak"),
+    )
+
+
+def canned_secure_plan(seed: int = 11) -> FaultPlan:
+    """Dropout matrix for the secure-agg gate (5 workers; warmup is
+    round 0, faults start at 1).  ``count=3`` on every drop outruns the
+    transport's 2 retries, so the drop sticks:
+
+    - round 1: device 0's train request is swallowed — its masked update
+      never folds, so recovery must reconstruct its SESSION SECRET and
+      strip its orphaned pair-mask halves;
+    - round 2: device 1 trains fine but goes silent during ``unmask`` —
+      the after-fold/before-unmask window; its self-mask comes back via
+      t-of-n shares from the other survivors;
+    - round 3: both at once — device 2 never trains, device 3 goes
+      silent in recovery;
+    - round 4: device 0 is deaf to ``share_setup`` — pruned before
+      training, which must NOT count as a mask recovery.
+    """
+    return FaultPlan([
+        FaultSpec(kind="drop_request", device_id="0", round=1, op="train",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="1", round=2, op="unmask",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="2", round=3, op="train",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="3", round=3, op="unmask",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="0", round=4,
+                  op="share_setup", count=3),
+    ], seed=seed)
+
+
+def oracle_plan(plan: FaultPlan) -> FaultPlan:
+    """The PLAIN-federation mirror of a secure-agg fault plan.
+
+    The exactness gate compares the secure run against plain FedAvg over
+    the same survivors, so the oracle must lose exactly the trainers the
+    secure run lost — and nothing else: ``share_setup`` drops become
+    ``train`` drops (a pruned device contributes nothing either way),
+    ``unmask`` drops vanish (the masked update already folded; plain has
+    no recovery phase to go silent in), everything else carries over."""
+    import dataclasses
+
+    specs = []
+    for f in plan.faults:
+        if f.op == "unmask":
+            continue
+        if f.op == "share_setup":
+            f = dataclasses.replace(f, op="train")
+        specs.append(f)
+    return FaultPlan(specs, seed=plan.seed)
+
+
+def run_secure_soak(rounds: int = 6, n_workers: int = 5,
+                    plan: Optional[FaultPlan] = None,
+                    round_timeout: float = 8.0,
+                    warmup_timeout: float = 120.0,
+                    atol: float = 2e-4,
+                    log_fn: Optional[Callable[[dict], None]] = None) -> dict:
+    """Chaos-gated exactness: a DH secure-agg federation and a plain
+    FedAvg oracle run LOCKSTEP in this process — same seed, same model
+    init, same data — with ``plan`` (default :func:`canned_secure_plan`)
+    hitting the secure run and :func:`oracle_plan` mirroring its trainer
+    losses onto the oracle.  After every post-warmup round the two
+    global models must agree to ``atol`` (float32 mask-cancellation
+    roundoff): masks recovered, self-masks removed, nothing leaked into
+    the sum.
+
+    The two plans install ALTERNATELY around each run_round call — the
+    injector seam is process-global and both fleets share device idents,
+    so a plan may only be live while its own coordinator is talking.
+
+    No ``evaluate_per_client`` here: per-client statistics are exactly
+    what secure aggregation hides, and the coordinator refuses."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+    from colearn_federated_learning_tpu.comm.coordinator import (
+        FederatedCoordinator,
+    )
+    from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+
+    if rounds < 2:
+        raise ValueError(f"rounds must be >= 2 (warmup + faulted), "
+                         f"got {rounds}")
+    cfg_secure = secure_soak_config(n_workers)
+    cfg_plain = cfg_secure.replace(
+        fed=dataclasses.replace(cfg_secure.fed, secure_agg=False),
+        run=dataclasses.replace(cfg_secure.run, name="secure_soak_oracle"),
+    )
+    plan = plan if plan is not None else canned_secure_plan()
+    plan_plain = oracle_plan(plan)
+
+    reg = telemetry.get_registry()
+    before = {name: reg.counter(name).value  # colearn: noqa(CL005)
+              for name in _SECURE_COUNTERS}
+
+    def flat(coord) -> np.ndarray:
+        return np.concatenate([
+            np.ravel(np.asarray(a))
+            for a in jax.tree.leaves(coord.server_state.params)
+        ])
+
+    fleets = []      # (broker, workers, coord) per federation
+    installed = False
+    try:
+        for cfg in (cfg_secure, cfg_plain):
+            broker = MessageBroker().start()
+            workers = [
+                DeviceWorker(cfg, i, broker.host, broker.port).start()
+                for i in range(n_workers)
+            ]
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=warmup_timeout,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=n_workers, timeout=30.0)
+            coord.trainers.sort(key=lambda d: int(d.device_id))
+            for w in workers:
+                w.await_role(timeout=10.0)
+            fleets.append((broker, workers, coord))
+        (_, _, coord_s), (_, _, coord_p) = fleets
+
+        diffs = []
+        for _ in range(rounds):
+            faulted = bool(coord_s.history)      # round 0 is the warmup
+            if faulted:
+                inject.install(plan)
+                installed = True
+            rec_s = coord_s.run_round()
+            if installed:
+                inject.uninstall()
+                installed = False
+            if faulted:
+                inject.install(plan_plain)
+                installed = True
+            rec_p = coord_p.run_round()
+            if installed:
+                inject.uninstall()
+                installed = False
+            if len(coord_s.history) == 1:
+                # Warmup done on both: drop to the faulted-round deadline.
+                coord_s.round_timeout = round_timeout
+                coord_p.round_timeout = round_timeout
+            diff = float(np.max(np.abs(flat(coord_s) - flat(coord_p))))
+            diffs.append(diff)
+            if log_fn is not None:
+                log_fn({"round": rec_s["round"], "param_diff": diff,
+                        "secure": strip_timing(rec_s),
+                        "oracle": strip_timing(rec_p)})
+    finally:
+        if installed:
+            inject.uninstall()
+        for broker, workers, coord in fleets:
+            for w in workers:
+                w.stop()
+            broker.stop()
+            coord.close()
+
+    records = list(coord_s.history)
+    return {
+        "rounds_run": len(records),
+        "records": records,
+        "oracle_records": list(coord_p.history),
+        "param_diffs": diffs,
+        "max_param_diff": max(diffs) if diffs else float("nan"),
+        "oracle_ok": bool(diffs) and all(d <= atol for d in diffs),
+        "skipped_rounds": [r["round"] for r in records
+                           if r.get("skipped_quorum")],
+        "counters": {
+            # Catalog-declared tuple (SECURE_SOAK_DELTA_COUNTERS).
+            name: reg.counter(name).value - before[name]  # colearn: noqa(CL005)
+            for name in _SECURE_COUNTERS
+        },
+        "faults_fired": dict(plan.fired) if plan is not None else {},
+        "oracle_faults_fired": dict(plan_plain.fired),
     }
 
 

@@ -279,6 +279,11 @@ class FederatedLearner:
                 "secure_agg_neighbors must be an even integer >= 2, got "
                 f"{c.fed.secure_agg_neighbors}"
             )
+        if c.fed.secure_agg and not 0.0 < c.fed.secure_agg_threshold <= 1.0:
+            raise ValueError(
+                "secure_agg_threshold must be in (0, 1], got "
+                f"{c.fed.secure_agg_threshold}"
+            )
         if self.scaffold and (c.fed.secure_agg or c.fed.dp_clip > 0.0):
             raise ValueError(
                 "scaffold is incompatible with secure_agg/dp hooks: the "

@@ -19,6 +19,15 @@ exactly params (fedavg / fedprox) are supported; adaptive server
 optimizers keep per-group moments that a param average would silently
 desynchronise, and scaffold's variates live per-client — both are
 rejected loudly.
+
+Secure aggregation composes GROUP-LOCALLY here (DisAgg-style): each edge
+group is its own ``FederatedLearner`` over ``clients_per_group`` clients,
+so with ``fed.secure_agg`` on, pair masks (and the dropout-recovery share
+fan-outs, privacy/dropout.py) span only the group — the per-device mask
+cost is O(group + neighbors) instead of O(cohort), and the system-wide
+pair count drops from O(cohort²) to O(cohort · group).  The cloud tier
+averages already-unmasked group means, exactly like the plain path.
+:meth:`HierarchicalLearner.mask_cost_summary` quantifies the cut.
 """
 
 from __future__ import annotations
@@ -190,6 +199,31 @@ class HierarchicalLearner:
             out["groups_dropped"] = dropped
         self.history.append(out)
         return out
+
+    def mask_cost_summary(self) -> dict:
+        """Per-device secure-agg cost of THIS topology vs the flat one.
+
+        Pure arithmetic on :func:`privacy.dropout.mask_cost` — no masking
+        has to be enabled to ask.  ``quadratic_ratio`` is the system-wide
+        pair-count cut the two-tier topology buys (flat O(cohort²) pairs
+        over grouped O(cohort · group)); bench_fleet's ``--mask-sweep``
+        reports the same columns at the 1M-device point."""
+        from colearn_federated_learning_tpu.privacy import dropout
+
+        cohort = self.config.data.num_clients
+        group = cohort // self.num_groups
+        cost = dropout.mask_cost(
+            cohort=cohort,
+            param_count=pytrees.tree_size(self.global_params),
+            neighbors=self.config.fed.secure_agg_neighbors,
+            group_size=group,
+        )
+        cost["num_groups"] = self.num_groups
+        cost["group_size"] = group
+        cost["quadratic_ratio"] = (
+            cost["flat_pairs_total"] / max(1, cost["grouped_pairs_total"])
+        )
+        return cost
 
     def evaluate(self) -> tuple[float, float]:
         """Cloud-model score on the global holdout.  Between syncs the

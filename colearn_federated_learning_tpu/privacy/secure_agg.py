@@ -99,6 +99,7 @@ def ring_partner_table(base_key: jax.Array, member_ids, cohort_ids, round_idx,
     return ring[(pos[:, None] + offs[None, :]) % C]                 # (M, 2k2)
 
 
+@jax.jit
 def pairwise_mask(template, base_key: jax.Array, client_id, partner_ids,
                   round_idx, std: float = 1.0):
     """The mask client ``client_id`` adds to its (pre-weighted) update.
@@ -106,6 +107,13 @@ def pairwise_mask(template, base_key: jax.Array, client_id, partner_ids,
     ``partner_ids``: (P,) ids this client shares pair keys with — the whole
     cohort for complete-graph masking (the self-pair contributes sign 0),
     or this client's row of :func:`ring_partner_table`.
+
+    Jitted AT MODULE LEVEL (as are the other mask expanders): the
+    ``fori_loop`` body is a fresh closure every call, so an eager call
+    re-traces and re-compiles the whole PRG expansion each time — ~seconds
+    per cohort member per round, which is what blew the wire plane's round
+    deadlines under the secure chaos soak.  A persistent jit cache keyed
+    on (tree structure, partner count) pays one compile per shape instead.
     """
     zeros = pytrees.tree_zeros_like(template)
 
@@ -119,6 +127,7 @@ def pairwise_mask(template, base_key: jax.Array, client_id, partner_ids,
     return jax.lax.fori_loop(0, partner_ids.shape[0], body, zeros)
 
 
+@jax.jit
 def mask_update(update, base_key: jax.Array, client_id, partner_ids, round_idx,
                 std: float = 1.0):
     """Add this client's pairwise mask to its update (before aggregation)."""
@@ -127,6 +136,7 @@ def mask_update(update, base_key: jax.Array, client_id, partner_ids, round_idx,
     return pytrees.tree_add(update, mask)
 
 
+@jax.jit
 def pairwise_mask_with_keys(template, pair_keys: jax.Array, signs: jax.Array,
                             round_idx, std: float = 1.0):
     """Pairwise mask from EXPLICIT per-pair PRNG keys — the wire-plane
@@ -154,6 +164,7 @@ def pairwise_mask_with_keys(template, pair_keys: jax.Array, signs: jax.Array,
     return jax.lax.fori_loop(0, pair_keys.shape[0], body, zeros)
 
 
+@jax.jit
 def mask_update_with_keys(update, pair_keys: jax.Array, signs: jax.Array,
                           round_idx, std: float = 1.0):
     """Explicit-key variant of :func:`mask_update` (wire plane / DH)."""
@@ -164,6 +175,7 @@ def mask_update_with_keys(update, pair_keys: jax.Array, signs: jax.Array,
 _SCALAR_STREAM_TAG = 0x7B17
 
 
+@jax.jit
 def mask_scalar(value, base_key: jax.Array, client_id, partner_ids,
                 round_idx, std: float = 1.0):
     """Pairwise-mask one SCALAR side-channel value (e.g. the adaptive-

@@ -179,6 +179,13 @@ class FedConfig:
     # tests).  The ENGINE plane ignores this: a simulation holds every
     # client in one process regardless.
     secure_agg_key_exchange: str = "dh"   # dh | shared_seed
+    # Dropout-recovery threshold (privacy/dropout.py): each client
+    # Shamir-shares its round secrets across its recovery set (its pairing
+    # partners) and reconstruction needs ceil(threshold · set_size)
+    # surviving shares.  Higher tolerates fewer dropouts but forces a
+    # bigger coalition to break a dead client's masks; 0.5 matches the
+    # Bonawitz honest-majority setting.
+    secure_agg_threshold: float = 0.5
     # Update compression on the wire/file planes (fed/compression.py).
     compress: str = "none"            # none | int8 | topk
     # DOWNLINK compression (synchronous coordinator broadcast): ship the

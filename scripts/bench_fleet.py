@@ -16,6 +16,14 @@ One JSON line per sweep point is appended to
 ``results/fleet_bench.jsonl`` (PERF.md "Fleet scale sweep" reads from
 there).
 
+``--mask-sweep`` adds ``fleet_mask_cost`` rows: the analytic per-device
+cost of dropout-tolerant secure aggregation (privacy/dropout.mask_cost)
+at ``--mask-devices`` cohort under group-local masking, swept over
+neighbor count k — per-device mask-PRG FLOPs, recovery-share bytes,
+and the grouped-vs-flat pair ratio that pins the absence of an
+O(cohort²) term.  The [tool.colearn.slo] sentinel bounds the new
+columns.
+
 Usage (CPU):
     JAX_PLATFORMS=cpu python scripts/bench_fleet.py
     JAX_PLATFORMS=cpu python scripts/bench_fleet.py \\
@@ -37,7 +45,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Schema contract for every row this bench writes; --check-schema (CI)
-# asserts it over the output file.
+# asserts it over the output file.  Rows carry a ``bench`` tag and are
+# validated against the schema for that tag (SCHEMAS).
 ROW_SCHEMA = {
     "bench": str,
     "devices": int,
@@ -54,6 +63,29 @@ ROW_SCHEMA = {
     "train_loss": float,
     "param_count": int,
     "bench_wall_s": float,
+}
+
+# Masked-uplink cost rows (--mask-sweep): the analytic per-device cost
+# of dropout-tolerant secure aggregation (privacy/dropout.mask_cost)
+# under group-local masking, swept over neighbor count k at fleet scale.
+MASK_ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "neighbors": int,
+    "group_size": int,
+    "param_count": int,
+    "mask_flops_per_device": float,
+    "share_bytes_per_device": float,
+    "pairs_per_device": int,
+    "flat_pairs_total": int,
+    "grouped_pairs_total": int,
+    "quadratic_ratio": float,
+    "bench_wall_s": float,
+}
+
+SCHEMAS = {
+    "fleet_round": ROW_SCHEMA,
+    "fleet_mask_cost": MASK_ROW_SCHEMA,
 }
 
 
@@ -126,8 +158,67 @@ def run_point(cohort: int, rounds: int, chunk: int, seed: int) -> dict:
     }
 
 
+def bench_param_count(seed: int) -> int:
+    """Parameter count of the bench model — initialized once against a
+    tiny throwaway population (the model is devices-independent, so the
+    1M-cohort mask sweep never has to materialize a 1M fleet)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from colearn_federated_learning_tpu import fleetsim
+    from colearn_federated_learning_tpu.fed import setup as setup_lib
+    from colearn_federated_learning_tpu.models import (
+        registry as model_registry,
+    )
+    from colearn_federated_learning_tpu.utils import prng
+
+    spec = fleetsim.PopulationSpec(
+        num_devices=8, num_classes=10, feature_dim=16,
+        shard_capacity=16, min_examples=4, seed=seed)
+    population = fleetsim.DevicePopulation(spec)
+    config = bench_config(spec.feature_dim, spec.num_classes)
+    model = model_registry.build_model(
+        setup_lib.local_model_config(config.model))
+    params = model_registry.init_params(
+        model, jnp.asarray(population.example_batch(config.fed.batch_size)),
+        prng.init_key(prng.experiment_key(config.run.seed)))
+    return int(sum(np.asarray(p).size for p in jax.tree.leaves(params)))
+
+
+def mask_point(devices: int, neighbors: int, group_size: int,
+               param_count: int) -> dict:
+    """One masked-uplink cost row: per-device PRG FLOPs + recovery-share
+    bytes under group-local secure aggregation at ``devices`` cohort,
+    plus the flat-graph quadratic total the layering avoids (reported as
+    ``quadratic_ratio`` so the sweep can PIN the absence of an
+    O(cohort²) term rather than eyeball it)."""
+    from colearn_federated_learning_tpu.privacy import dropout
+
+    t0 = time.time()
+    cost = dropout.mask_cost(cohort=devices, param_count=param_count,
+                             neighbors=neighbors, group_size=group_size)
+    return {
+        "bench": "fleet_mask_cost",
+        "devices": devices,
+        "neighbors": neighbors,
+        "group_size": group_size,
+        "param_count": param_count,
+        "mask_flops_per_device": cost["mask_flops_per_device"],
+        "share_bytes_per_device": cost["share_bytes_per_device"],
+        "pairs_per_device": cost["pairs_per_device"],
+        "flat_pairs_total": cost["flat_pairs_total"],
+        "grouped_pairs_total": cost["grouped_pairs_total"],
+        "quadratic_ratio": round(
+            cost["flat_pairs_total"] / max(1, cost["grouped_pairs_total"]),
+            2),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
 def check_schema(path: str) -> int:
-    """Validate every row of a bench JSONL against ROW_SCHEMA (CI gate)."""
+    """Validate every row of a bench JSONL against the schema for its
+    ``bench`` tag (CI gate)."""
     bad = 0
     with open(path) as f:
         rows = [json.loads(line) for line in f if line.strip()]
@@ -135,7 +226,8 @@ def check_schema(path: str) -> int:
         print(f"FAIL: {path} is empty", file=sys.stderr)
         return 1
     for i, row in enumerate(rows):
-        for key, typ in ROW_SCHEMA.items():
+        schema = SCHEMAS.get(row.get("bench"), ROW_SCHEMA)
+        for key, typ in schema.items():
             if key not in row:
                 print(f"FAIL: row {i} missing {key!r}", file=sys.stderr)
                 bad += 1
@@ -146,7 +238,7 @@ def check_schema(path: str) -> int:
                 print(f"FAIL: row {i} {key!r} not {typ.__name__}",
                       file=sys.stderr)
                 bad += 1
-        if row.get("clients_trained", 0) <= 0:
+        if schema is ROW_SCHEMA and row.get("clients_trained", 0) <= 0:
             print(f"FAIL: row {i} trained no clients", file=sys.stderr)
             bad += 1
     if not bad:
@@ -167,7 +259,26 @@ def main(argv=None) -> int:
         "results", "fleet_bench.jsonl"))
     ap.add_argument("--check-schema", action="store_true",
                     help="after the sweep, validate the output JSONL "
-                         "against ROW_SCHEMA and fail on any mismatch")
+                         "against the per-bench schemas and fail on any "
+                         "mismatch")
+    ap.add_argument("--mask-sweep", action="store_true",
+                    help="append fleet_mask_cost rows: the analytic "
+                         "secure-agg masked-uplink cost per device at "
+                         "--mask-devices, swept over --mask-neighbors "
+                         "(privacy/dropout.mask_cost)")
+    ap.add_argument("--mask-devices", type=int, default=1_000_000,
+                    help="cohort size for the mask-cost sweep")
+    ap.add_argument("--mask-neighbors", default="0,2,4,8,16",
+                    help="comma-separated neighbor counts k to sweep "
+                         "(0 = complete graph WITHIN the group, the row "
+                         "that pins the grouped-vs-flat quadratic ratio)")
+    ap.add_argument("--mask-group-size", type=int, default=1024,
+                    help="group-local masking group size (0 = flat "
+                         "all-cohort graph)")
+    ap.add_argument("--append", action="store_true",
+                    help="append rows to --out instead of rewriting it "
+                         "(e.g. --cohorts '' --mask-sweep --append adds "
+                         "mask-cost rows next to a committed round sweep)")
     args = ap.parse_args(argv)
 
     rows = []
@@ -175,9 +286,16 @@ def main(argv=None) -> int:
         row = run_point(cohort, args.rounds, args.chunk, args.seed)
         rows.append(row)
         print(json.dumps(row))
+    if args.mask_sweep:
+        param_count = bench_param_count(args.seed)
+        for k in (int(x) for x in args.mask_neighbors.split(",") if x):
+            row = mask_point(args.mask_devices, k, args.mask_group_size,
+                             param_count)
+            rows.append(row)
+            print(json.dumps(row))
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
+    with open(args.out, "a" if args.append else "w") as f:
         for row in rows:
             f.write(json.dumps(row) + "\n")
     print(f"wrote {len(rows)} rows to {args.out}")

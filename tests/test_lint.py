@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL009), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL011), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -534,6 +534,80 @@ def test_cl010_suppression(tmp_path):
         def report(x):
             print(x)  # colearn: noqa(CL010)
     """, relpath="pkg/fed/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL011 ----
+def test_cl011_flags_expander_call_per_pair(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.privacy.secure_agg import pairwise_mask
+
+        def mask_all(update, key, me, partners, rnd):
+            for p in partners:  # colearn: hot
+                update = update + pairwise_mask(update, key, me, p, rnd)
+            return update
+    """, relpath="pkg/privacy/mod.py")
+    assert rule_ids(res) == ["CL011"]
+    assert res.exit_code == 1
+
+
+def test_cl011_flags_per_pair_head_in_comm(tmp_path):
+    res = run_lint(tmp_path, """
+        def fold_masks(pair_rows, expand, acc):
+            for pair in pair_rows:  # colearn: hot
+                acc = acc + expand(pair)
+            return acc
+    """, relpath="pkg/comm/mod.py")
+    assert rule_ids(res) == ["CL011"]
+
+
+def test_cl011_allows_key_derivation_loop(tmp_path):
+    # The sanctioned per-pair loop: deriving the KEY TABLE (one scalar
+    # modexp per pair), which then feeds ONE *_with_keys dispatch.
+    res = run_lint(tmp_path, """
+        from pkg.comm.keyexchange import pair_prng_key, shared_secret
+        from pkg.privacy.secure_agg import mask_update_with_keys
+
+        def mask(update, priv, me, peers, pubs, signs, rnd):
+            keys = []
+            for p in peers:  # colearn: hot
+                keys.append(pair_prng_key(shared_secret(priv, pubs[p]),
+                                          me, p))
+            return mask_update_with_keys(update, keys, signs, rnd)
+    """, relpath="pkg/comm/worker.py")
+    assert res.findings == []
+
+
+def test_cl011_ignores_unmarked_and_out_of_scope_loops(tmp_path):
+    src = """
+        from pkg.privacy.secure_agg import pairwise_mask
+
+        def cold(update, key, me, partners, rnd):
+            for p in partners:
+                update = update + pairwise_mask(update, key, me, p, rnd)
+            return update
+    """
+    # Unmarked loop in privacy/: cold paths may iterate per pair.
+    res = run_lint(tmp_path, src, relpath="pkg/privacy/mod.py")
+    assert res.findings == []
+    # Marked per-pair loop OUTSIDE privacy//comm/: not CL011's business.
+    res = run_lint(tmp_path, """
+        def sweep(pair_counts, probe):
+            for pairs in pair_counts:  # colearn: hot
+                probe(pairs)
+    """, relpath="pkg/fleetsim/mod.py")
+    assert res.findings == []
+
+
+def test_cl011_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.privacy.secure_agg import mask_scalar
+
+        def debug_mask(xs, key, me, partners, rnd):
+            for p in partners:  # colearn: hot  # colearn: noqa(CL011)
+                xs = mask_scalar(xs, key, me, p, rnd)
+            return xs
+    """, relpath="pkg/privacy/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
 

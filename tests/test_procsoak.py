@@ -22,14 +22,17 @@ import chaos_soak_mp  # noqa: E402
 def test_kill_spec_validation():
     KillSpec("worker:3", after_round=0)
     KillSpec("coordinator", after_round=2)
+    KillSpec("broker", after_round=1)
     with pytest.raises(ValueError, match="target"):
-        KillSpec("broker", after_round=0)
+        KillSpec("edge", after_round=0)
     with pytest.raises(ValueError, match="target"):
         KillSpec("worker:x", after_round=0)
     with pytest.raises(ValueError, match="after_round"):
         KillSpec("coordinator", after_round=-1)
     with pytest.raises(ValueError, match="restart"):
         KillSpec("coordinator", after_round=0, restart=False)
+    with pytest.raises(ValueError, match="restart"):
+        KillSpec("broker", after_round=0, restart=False)
 
 
 def test_canned_schedule_scales_with_run_length():
@@ -37,8 +40,11 @@ def test_canned_schedule_scales_with_run_length():
     assert [k.target for k in short] == ["coordinator"]
     assert short[0].after_round == 0       # after the first checkpoint
     full = canned_kill_schedule(6, 3)
-    assert [k.target for k in full] == ["worker:1", "coordinator"]
-    assert full[-1].after_round == 2
+    assert [k.target for k in full] == ["worker:1", "coordinator", "broker"]
+    assert full[1].after_round == 2
+    # The broker dies a round after the coordinator resumed, with a full
+    # round left to prove the federation still commits past the rebind.
+    assert full[-1].after_round == 3
 
 
 def _summary(**over):
@@ -115,3 +121,31 @@ def test_proc_soak_coordinator_sigkill_resumes(tmp_path):
     victim = by_pid[s["kills"][0]["pid"]]
     assert victim["schema"] == "colearn-flight-v1"
     assert victim["role"] == "coordinator"
+
+
+@pytest.mark.slow
+def test_proc_soak_broker_sigkill_heals(tmp_path):
+    """Control-plane SPOF: a real SIGKILL to the broker process after
+    round 1 — the harness rebinds a fresh broker on the SAME port, the
+    workers' re-enrollment watchdogs and the coordinator's
+    ``_rebuild_broker`` heal into it, and the remaining round budget
+    still commits with a final score."""
+    kills = [KillSpec("broker", after_round=1)]
+    s = run_proc_soak(rounds=3, n_workers=2, kills=kills,
+                      workdir=str(tmp_path), round_timeout=120.0,
+                      timeout_s=420.0)
+    assert s["exit_code"] == 0
+    assert s["rounds_run"] == 3
+    assert s["coordinator_incarnations"] == 1   # only the broker died
+    assert len(s["kills"]) == 1
+    assert s["kills"][0]["target"] == "broker"
+    assert s["weighted_acc"] is not None
+    # The broker flies the black box too: its SIGKILLed pid must have
+    # left a parseable dump like any other victim.
+    assert all("pid" in k for k in s["kills"])
+    assert s["flight_missing"] == []
+    from colearn_federated_learning_tpu.telemetry import flight
+
+    dumps = flight.load_flight_dumps(str(tmp_path / "flight"))
+    by_pid = {d.get("pid"): d for d in dumps if "error" not in d}
+    assert by_pid[s["kills"][0]["pid"]]["role"] == "broker"

@@ -1,8 +1,15 @@
 """Secure-aggregation masking: exact cancellation for the complete graph
-and the k-regular random ring, ring symmetry, and engine integration at a
-cohort size where all-pairs masking would be the dominant cost."""
+and the k-regular random ring, ring symmetry, engine integration at a
+cohort size where all-pairs masking would be the dominant cost, and the
+dropout matrix — Shamir recovery algebra, wire-plane mask recovery
+pinned against a plain-FedAvg oracle (0 / 1 / k maskers dropped), the
+hard failure below the recovery threshold, and group-local masking on
+hierarchical topologies.  (The async half of the matrix is the
+NotImplementedError pin in tests/test_async_coordinator.py: pairwise
+masks need an agreed per-round cohort the async pumps don't have.)"""
 
 import dataclasses
+import itertools
 
 import jax
 import jax.numpy as jnp
@@ -10,6 +17,7 @@ import numpy as np
 import pytest
 
 from colearn_federated_learning_tpu.fed.engine import FederatedLearner
+from colearn_federated_learning_tpu.privacy import dropout
 from colearn_federated_learning_tpu.privacy import secure_agg as sa
 from colearn_federated_learning_tpu.utils.config import (
     DataConfig,
@@ -120,3 +128,297 @@ def test_engine_ring_masking_learns():
     loss_ap, acc_ap = allpairs.evaluate()
     np.testing.assert_allclose(loss, loss_ap, rtol=1e-3)
     np.testing.assert_allclose(acc, acc_ap, rtol=1e-3)
+
+
+# ------------------------------------------------ dropout recovery core --
+def test_shamir_recovery_matrix():
+    """t-of-n reconstruction over the full drop matrix: every share, any
+    exactly-t subset, and the HARD failure one share below threshold."""
+    secret = dropout.random_secret()
+    xs = [1, 2, 3, 4, 5]
+    t = 3
+    shares = dropout.split_secret(secret, xs, t)
+    assert set(shares) == set(xs)
+    # 0 dropped: all n shares reconstruct.
+    assert dropout.reconstruct(shares, t) == secret
+    # Down to exactly t survivors, ANY subset works (Lagrange at 0 is
+    # subset-independent) — this is what lets the coordinator recover
+    # with whichever shareholders happen to answer.
+    for keep in itertools.combinations(xs, t):
+        sub = {x: shares[x] for x in keep}
+        assert dropout.reconstruct(sub, t) == secret
+    # t − 1 survivors: RecoveryError, never a wrong secret.
+    with pytest.raises(dropout.RecoveryError):
+        dropout.reconstruct({x: shares[x] for x in xs[: t - 1]}, t)
+    # A degenerate threshold never reconstructs from nothing.
+    with pytest.raises(dropout.RecoveryError):
+        dropout.reconstruct({}, 1)
+
+
+def test_threshold_count_convention():
+    """t = max(1, ceil(fraction · n)); 0 only for an empty recovery set
+    (solo cohort — no partners, no self-mask)."""
+    assert dropout.threshold_count(4, 0.5) == 2
+    assert dropout.threshold_count(4, 0.75) == 3   # the wire test's t
+    assert dropout.threshold_count(5, 0.5) == 3
+    assert dropout.threshold_count(1, 0.5) == 1    # floor at 1
+    assert dropout.threshold_count(4, 1.0) == 4
+    assert dropout.threshold_count(0, 0.5) == 0
+    with pytest.raises(ValueError, match="secure_agg_threshold"):
+        dropout.threshold_count(4, 0.0)
+    with pytest.raises(ValueError, match="secure_agg_threshold"):
+        dropout.threshold_count(4, 1.5)
+
+
+def test_split_secret_validates_inputs():
+    with pytest.raises(ValueError, match="out of range"):
+        dropout.split_secret(5, [1, 2], 3)         # t > n
+    with pytest.raises(ValueError, match="distinct and nonzero"):
+        dropout.split_secret(5, [0, 1], 1)
+    with pytest.raises(ValueError, match="distinct and nonzero"):
+        dropout.split_secret(5, [2, 2], 1)
+    with pytest.raises(ValueError, match="field range"):
+        dropout.split_secret(dropout.PRIME, [1, 2], 1)
+
+
+def test_oracle_plan_mirrors_trainer_losses_only():
+    """The exactness oracle loses exactly the trainers the secure run
+    lost: unmask silence vanishes (plain has no recovery phase),
+    share_setup deafness becomes a train drop (pruned either way)."""
+    from colearn_federated_learning_tpu.faults import FaultPlan, FaultSpec
+    from colearn_federated_learning_tpu.faults import soak
+
+    plan = FaultPlan([
+        FaultSpec(kind="drop_request", device_id="0", round=1, op="train",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="1", round=2, op="unmask",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="2", round=3,
+                  op="share_setup", count=3),
+    ], seed=5)
+    mirrored = soak.oracle_plan(plan)
+    assert [(f.device_id, f.round, f.op) for f in mirrored.faults] == [
+        ("0", 1, "train"), ("2", 3, "train")]
+    assert mirrored.seed == plan.seed
+
+
+def test_mask_cost_has_no_cohort_quadratic_term():
+    """Group-local layering: per-device cost depends on the group and the
+    ring degree, never on the cohort — the analytic model the 1M-device
+    bench sweep (scripts/bench_fleet.py --mask-sweep) gates in CI."""
+    small = dropout.mask_cost(10_000, 874, neighbors=0, group_size=1024)
+    large = dropout.mask_cost(1_000_000, 874, neighbors=0, group_size=1024)
+    for field in ("mask_flops_per_device", "share_bytes_per_device",
+                  "pairs_per_device"):
+        assert small[field] == large[field], field
+    # System-wide pair counts DO scale with the cohort — linearly under
+    # grouping, quadratically flat: the separation grows with cohort.
+    ratio = large["flat_pairs_total"] / large["grouped_pairs_total"]
+    assert ratio > 100
+    assert ratio > small["flat_pairs_total"] / small["grouped_pairs_total"]
+    # A ring degree caps the per-device cost below the full group.
+    ring = dropout.mask_cost(1_000_000, 874, neighbors=4, group_size=1024)
+    assert ring["pairs_per_device"] == 4
+    assert ring["mask_flops_per_device"] < large["mask_flops_per_device"]
+
+
+# ------------------------------------------------- wire dropout matrix --
+def _flat_params(coord):
+    return np.concatenate([
+        np.ravel(np.asarray(a))
+        for a in jax.tree.leaves(coord.server_state.params)
+    ])
+
+
+@pytest.mark.slow
+def test_wire_dropout_matrix_exact_recovery():
+    """0, 1, and 2 maskers killed mid-train across consecutive rounds:
+    every post-recovery aggregate must match a plain-FedAvg oracle over
+    the same survivors, with every dead masker attributed in
+    privacy.masks_recovered_total and no round skipped or discarded."""
+    from colearn_federated_learning_tpu.faults import FaultPlan, FaultSpec
+    from colearn_federated_learning_tpu.faults import soak
+
+    # round 1: one masker dies (d=1); round 2: two die at once (d=2,
+    # folded 3/5 stays at quorum); round 3: clean again (d=0 — recovery
+    # must not have corrupted cross-round state).  Round 0 is the jit
+    # warmup, also d=0.  count=3 outruns the transport's 2 retries.
+    plan = FaultPlan([
+        FaultSpec(kind="drop_request", device_id="0", round=1, op="train",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="1", round=2, op="train",
+                  count=3),
+        FaultSpec(kind="drop_request", device_id="2", round=2, op="train",
+                  count=3),
+    ], seed=13)
+    summary = soak.run_secure_soak(rounds=4, n_workers=5, plan=plan,
+                                   round_timeout=8.0)
+    assert summary["rounds_run"] == 4
+    assert summary["oracle_ok"], summary["param_diffs"]
+    assert summary["skipped_rounds"] == []
+    assert not any(r.get("unmask_failed") for r in summary["records"])
+    counters = summary["counters"]
+    assert counters["privacy.masks_recovered_total"] == 3   # one per dead
+    assert counters["privacy.share_recovery_failures_total"] == 0
+    assert counters["fed.rounds_skipped_quorum"] == 0
+    # Every clean round folded all 5; the faulted rounds folded 4 and 3.
+    assert [r["completed"] for r in summary["records"]] == [5, 4, 3, 5]
+
+
+@pytest.mark.slow
+def test_wire_unmask_threshold_boundary():
+    """The recovery threshold is sharp at t = ceil(0.75 · 4) = 3 shares:
+    2 maskers silent during unmask leaves exactly 3 reachable
+    shareholders per origin — just-at-threshold, exact recovery — while
+    3 silent leaves 2 < t, a HARD failure that discards the round
+    (params unchanged) and attributes it in
+    privacy.share_recovery_failures_total."""
+    from colearn_federated_learning_tpu import telemetry
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+    from colearn_federated_learning_tpu.comm.coordinator import (
+        FederatedCoordinator,
+    )
+    from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+    from colearn_federated_learning_tpu.faults import (
+        FaultPlan,
+        FaultSpec,
+        inject,
+    )
+    from colearn_federated_learning_tpu.faults import soak
+
+    atol = 2e-4
+    n = 5
+    cfg_s = soak.secure_soak_config(n)
+    cfg_s = cfg_s.replace(
+        fed=dataclasses.replace(cfg_s.fed, secure_agg_threshold=0.75))
+    cfg_p = cfg_s.replace(
+        fed=dataclasses.replace(cfg_s.fed, secure_agg=False),
+        run=dataclasses.replace(cfg_s.run, name="threshold_oracle"),
+    )
+
+    def silence_at_unmask(round_idx, devices):
+        return FaultPlan([
+            FaultSpec(kind="drop_request", device_id=str(d),
+                      round=round_idx, op="unmask", count=3)
+            for d in devices
+        ], seed=17)
+
+    reg = telemetry.get_registry()
+
+    def counters():
+        return {name: reg.counter(name).value  # colearn: noqa(CL005)
+                for name in ("privacy.masks_recovered_total",
+                             "privacy.share_recovery_failures_total")}
+
+    fleets = []
+    installed = False
+    try:
+        for cfg in (cfg_s, cfg_p):
+            broker = MessageBroker().start()
+            workers = [
+                DeviceWorker(cfg, i, broker.host, broker.port).start()
+                for i in range(n)
+            ]
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=120.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=n, timeout=30.0)
+            coord.trainers.sort(key=lambda d: int(d.device_id))
+            for w in workers:
+                w.await_role(timeout=10.0)
+            fleets.append((broker, workers, coord))
+        (_, _, coord_s), (_, _, coord_p) = fleets
+
+        # Round 0: clean warmup on both — the d=0 baseline.
+        rec0 = coord_s.run_round()
+        coord_p.run_round()
+        coord_s.round_timeout = coord_p.round_timeout = 8.0
+        assert not rec0["unmask_failed"]
+        np.testing.assert_allclose(_flat_params(coord_s),
+                                   _flat_params(coord_p), atol=atol)
+
+        # Round 1: d=2 unmask-silent — 3 answering shareholders, exactly
+        # t.  All 5 updates folded, so the clean oracle is the truth.
+        before = counters()
+        inject.install(silence_at_unmask(1, (0, 1)))
+        installed = True
+        rec1 = coord_s.run_round()
+        inject.uninstall()
+        installed = False
+        coord_p.run_round()
+        assert not rec1["unmask_failed"]
+        assert rec1["completed"] == n
+        np.testing.assert_allclose(_flat_params(coord_s),
+                                   _flat_params(coord_p), atol=atol)
+        delta = {k: counters()[k] - before[k] for k in before}
+        assert delta["privacy.share_recovery_failures_total"] == 0
+        assert delta["privacy.masks_recovered_total"] == 0   # nobody died
+
+        # Round 2: d=3 — 2 reachable shareholders < t=3.  The round must
+        # be DISCARDED (a sum with unremoved self-masks is garbage), not
+        # released approximately.
+        frozen = _flat_params(coord_s)
+        before = counters()
+        inject.install(silence_at_unmask(2, (0, 1, 2)))
+        installed = True
+        rec2 = coord_s.run_round()
+        inject.uninstall()
+        installed = False
+        assert rec2["unmask_failed"] is True
+        np.testing.assert_array_equal(_flat_params(coord_s), frozen)
+        delta = {k: counters()[k] - before[k] for k in before}
+        assert delta["privacy.share_recovery_failures_total"] >= 1
+        assert delta["privacy.masks_recovered_total"] == 0
+    finally:
+        if installed:
+            inject.uninstall()
+        for broker, workers, coord in fleets:
+            for w in workers:
+                w.stop()
+            broker.stop()
+            coord.close()
+
+
+# ------------------------------------------------- hierarchical groups --
+def test_hierarchical_group_local_masking_matches_plain():
+    """Group-local secure aggregation on the two-tier topology: masks
+    span only each edge group, cancel within it, and the synced cloud
+    model matches the unmasked run — at O(group) per-device cost."""
+    from colearn_federated_learning_tpu.fed.hierarchical import (
+        HierarchicalLearner,
+    )
+
+    def cfg(**fed_kw):
+        fed = dict(strategy="fedavg", rounds=2, cohort_size=0,
+                   local_steps=2, batch_size=16, lr=0.1, momentum=0.9)
+        fed.update(fed_kw)
+        return ExperimentConfig(
+            data=DataConfig(dataset="mnist_tiny", num_clients=8,
+                            partition="iid", max_examples_per_client=32),
+            model=ModelConfig(name="mlp", num_classes=10, hidden_dim=16,
+                              depth=1),
+            fed=FedConfig(**fed),
+            run=RunConfig(name="hier_sa", backend="cpu"),
+        )
+
+    secure = HierarchicalLearner(cfg(secure_agg=True), num_groups=2,
+                                 sync_period=2)
+    plain = HierarchicalLearner(cfg(), num_groups=2, sync_period=2)
+    secure.fit(rounds=2)
+    plain.fit(rounds=2)
+
+    def flat(tree):
+        return np.concatenate([np.ravel(np.asarray(a))
+                               for a in jax.tree.leaves(tree)])
+
+    np.testing.assert_allclose(flat(secure.global_params),
+                               flat(plain.global_params), atol=2e-4)
+
+    cost = secure.mask_cost_summary()
+    assert cost["num_groups"] == 2 and cost["group_size"] == 4
+    # Masks never leave the group: per-device pair count is bounded by
+    # the group, not the cohort, and the system-wide pair count beats
+    # the flat topology's quadratic.
+    assert cost["pairs_per_device"] <= cost["group_size"] - 1
+    assert cost["quadratic_ratio"] > 1.0
+    assert cost["grouped_pairs_total"] < cost["flat_pairs_total"]
