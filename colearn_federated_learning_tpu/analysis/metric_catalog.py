@@ -43,6 +43,10 @@ COUNTERS = (
     "comm.broadcast_encode_total",   # CLW1 encodes of a broadcast frame
     "comm.bytes_saved_downlink",     # delta vs full-params payload bytes
     "comm.resync_total",             # worker cache misses → full re-send
+    # sharded server plane (parallel/partition.py, comm/downlink.py):
+    # per-chip replication bytes the gather-free downlink never
+    # materialized (per-shard host reads instead of a full-tree gather)
+    "comm.gather_bytes_avoided_total",
     # key exchange & broker healing (comm/keyexchange.py, comm/coordinator.py)
     "comm.keyexchange_rejected_total",  # labeled {reason=zero|identity|...}
     "comm.broker_reconnects_total",     # labeled {outcome=ok|failed}
@@ -62,6 +66,10 @@ COUNTERS = (
     "fed.clients_evicted",
     "fed.rounds_skipped_quorum",
     "fed.rounds_resumed_total",      # --resume restored a checkpoint
+    # tp_size degraded to a replicated layout (fed/engine.py from_config,
+    # parallel/partition.py make_server_placement); labeled
+    # {reason=indivisible_devices|insufficient_devices|rules_matched_nothing}
+    "fed.mesh_fallback_total",
     # file & hierarchical planes (fed/offline.py, fed/hierarchical.py)
     "fed.offline_updates_rejected_total",  # labeled {reason=torn|stale|...}
     "fed.hier_groups_dropped_total",       # labeled per group: {group=g1}
@@ -74,6 +82,7 @@ COUNTERS = (
     "fleetsim.clients_trained_total",
     "fleetsim.bytes_up_est_total",     # wire-codec frame estimate, uplink
     "fleetsim.bytes_down_est_total",   # wire-codec frame estimate, downlink
+    "fleetsim.bytes_gather_avoided_est_total",  # sharded-downlink estimate
     # runtime observability plane (telemetry/runtime.py, telemetry/flight.py)
     "telemetry.compile_total",       # labeled {fn=<name>}: distinct XLA sigs
     "telemetry.recompile_total",     # labeled {fn,reason=shape|dtype|structure}
@@ -89,6 +98,10 @@ GAUGES = (
     "fleetsim.devices",
     "fleetsim.chunk_size",
     "fleetsim.available_fraction",
+    # sharded server: measured per-chip server-state bytes (per-shard
+    # accounting via parallel/partition.bytes_per_chip — deterministic
+    # even where memory_stats() is empty)
+    "comm.server_bytes_per_chip",
     # live HBM sampling (telemetry/runtime.py; empty on CPU backends)
     "runtime.hbm_bytes_in_use",
     "runtime.hbm_bytes_limit",

@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL011).
+"""The colearn rule set (CL001–CL012).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -653,3 +653,74 @@ class PerPairLoopInMaskingHotPath(Rule):
                     f"`# colearn: hot` loop iterates per "
                     f"{per_pair[0]!r}: pairs must be a table axis — "
                     "expand every mask in one *_with_keys dispatch")
+
+
+# ----------------------------------------------------------------- CL012 --
+@register
+class FullTreeGatherInHotWirePath(Rule):
+    """The sharded-server wire path (PR 9) never gathers the full model:
+    the downlink encoder and the streaming fold read/scatter PER-DEVICE
+    shards (parallel/partition.host_leaf / ServerPlacement.slice_tree),
+    so no chip ever materializes a replicated copy and multi-host meshes
+    stay legal.  A ``jax.device_get(...)`` — or the tree-mapped
+    ``np.asarray`` full-tree-gather idiom — inside a ``# colearn: hot``
+    region of the comm plane reintroduces exactly the O(model) gather the
+    refactor removed."""
+
+    id = "CL012"
+    title = "full-tree gather on a hot downlink/aggregation path"
+    hint = ("read per-device shards instead (parallel/partition."
+            "host_tree, comm/downlink.host_params) or stage per-shard "
+            "slices (ServerPlacement.slice_tree); mark a justified "
+            "host-side conversion with `# colearn: noqa(CL012)`")
+
+    _GATHERS = {"jax.device_get", "device_get"}
+    _CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+                   "jnp.asarray"}
+    _TREE_MAPS = {"jax.tree.map", "jax.tree_map", "jax.tree_util.tree_map",
+                  "tree.map", "tree_map"}
+    # Hot markers land on statement heads: defs, loops, withs, or the
+    # offending statement line itself.
+    _REGIONS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.For, ast.While,
+                ast.With)
+
+    def _gather(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        dotted = dotted_name(node.func)
+        if dotted in self._GATHERS:
+            return f"{dotted}()"
+        if dotted in self._TREE_MAPS and node.args:
+            first = dotted_name(node.args[0])
+            if first in self._CONVERTERS:
+                return f"{dotted}({first}, ...)"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("comm"):
+            return
+        hot = ctx.hot_lines()
+        if not hot:
+            return
+        seen: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, self._REGIONS) and node.lineno in hot:
+                inners: Iterator[ast.AST] = ast.walk(node)
+            elif isinstance(node, ast.Call) and node.lineno in hot:
+                inners = iter((node,))
+            else:
+                continue
+            for inner in inners:
+                what = self._gather(inner)
+                if what is None:
+                    continue
+                key = (inner.lineno, inner.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, inner,
+                    f"{what} inside a `# colearn: hot` wire path gathers "
+                    "the full tree to one host buffer per chip; read "
+                    "per-device shards (partition.host_tree) or stage "
+                    "per-shard slices instead")

@@ -32,6 +32,11 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help=f"experiment config; one of {sorted(CONFIGS)}")
     p.add_argument("--backend", choices=["auto", "tpu", "cpu"], default=None)
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--tp-size", type=int, default=None,
+                   help="model-axis size: shard the global model over a "
+                        "tensor-parallel mesh (engine) and the server "
+                        "plane over a (model,) mesh (coordinator; "
+                        "parallel/partition.py)")
     p.add_argument("--rounds", type=int, default=None)
     p.add_argument("--num-clients", type=int, default=None)
     p.add_argument("--cohort-size", type=int, default=None)
@@ -217,7 +222,7 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "min_cohort_fraction"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _MODEL_KEYS = {"attn_impl", "remat", "stem", "norm", "width"}
-_RUN_KEYS = {"backend", "seed", "eval_every", "log_every", "checkpoint_dir",
+_RUN_KEYS = {"backend", "seed", "tp_size", "eval_every", "log_every",
              "checkpoint_every", "profile_dir", "trace_dir", "trace_rounds",
              "evict_after", "worker_enroll_timeout", "comm_retries",
              "comm_backoff_base", "comm_backoff_max", "fault_plan",

@@ -77,17 +77,28 @@ class StreamingFolder(UpdateFolder):
 
     ``fold_s`` accumulates time spent inside ``add`` — the work the
     overlap hides — surfaced as the round's ``phase_fold_overlap_s``.
+
+    With ``placement`` (a :class:`parallel.partition.ServerPlacement`, the
+    PR 9 sharded server) every staged contribution is immediately SLICED
+    into its per-shard layout — the symmetric scatter of the uplink decode
+    — so the fold accumulates shard-wise and :meth:`mean` assembles a
+    sharded ``jax.Array`` tree where each device receives only its own
+    shard bytes (no replicated device intermediate).  Per element the sum
+    sequence is unchanged (same contributions, same cohort order), so the
+    sharded fold is BITWISE identical to the replicated one.
     """
 
-    def __init__(self, shapes: Any, order: Optional[Sequence[str]] = None):
+    def __init__(self, shapes: Any, order: Optional[Sequence[str]] = None,
+                 placement: Optional[Any] = None):
         super().__init__(shapes)
         self._order = list(order) if order is not None else None
         self._staged: dict[str, tuple[float, Any, float]] = {}
+        self._placement = placement
         self.fold_s = 0.0
         self.folded_ids: list[str] = []
         self._finalized = False
 
-    def add(self, meta: dict, delta: Any,
+    def add(self, meta: dict, delta: Any,  # colearn: hot
             weight: Optional[float] = None) -> float:
         from colearn_federated_learning_tpu.fed import compression
 
@@ -96,7 +107,14 @@ class StreamingFolder(UpdateFolder):
         t0 = time.perf_counter()
         delta = compression.decompress_delta(delta, meta, shapes=self.shapes)
         w = float(meta.get("weight", 1.0)) if weight is None else float(weight)
-        contrib = pytrees.tree_scale(jax.tree.map(np.asarray, delta), w)
+        # Wire deltas are host numpy straight off the decode — the asarray
+        # normalizes dtypes/views, it cannot touch a device.
+        contrib = pytrees.tree_scale(
+            jax.tree.map(np.asarray, delta), w)  # colearn: noqa(CL012)
+        if self._placement is not None:
+            # Shard-wise staging: each leaf becomes the tuple of its
+            # per-shard slices (uplink decode scattered symmetrically).
+            contrib = self._placement.slice_tree(contrib)
         cid = str(meta.get("client_id", len(self._staged)))
         self._staged[cid] = (w, contrib,
                              float(meta.get("mean_loss", 0.0)) * w)
@@ -138,8 +156,18 @@ class StreamingFolder(UpdateFolder):
             )
         if self.wsum is None:
             return
+        if self._placement is not None:
+            # Same per-shard layout as the staged contributions; the
+            # subtraction runs slice-wise, elementwise-identical to the
+            # full-leaf subtraction.
+            tree = self._placement.slice_tree(tree)
         self.wsum = pytrees.tree_sub(self.wsum, tree)
 
     def mean(self) -> tuple[Optional[Any], float, float]:
         self.finalize()
-        return super().mean()
+        mean_delta, total_w, mean_loss = super().mean()
+        if mean_delta is not None and self._placement is not None:
+            # Per-shard slices → a sharded jax.Array tree: every device
+            # receives exactly its own shard bytes, never the full leaf.
+            mean_delta = self._placement.assemble(mean_delta)
+        return mean_delta, total_w, mean_loss

@@ -119,7 +119,40 @@ class FederatedCoordinator:
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy,
                                          device_type=device_type)
         params = setup_lib.init_global_params(config)
+        # PR 9 sharded server: with run.tp_size > 1 the global model,
+        # optimizer state, and aggregation live SHARDED over a local 1-D
+        # (model,) mesh — the streaming fold stages per-shard slices, the
+        # server update runs on sharded params, and the downlink encoder
+        # reads device shards directly (comm/downlink.host_params).  When
+        # the host cannot honor tp_size the fallback is counted in
+        # fed.mesh_fallback_total{reason} and the coordinator runs
+        # replicated exactly as before.
+        from colearn_federated_learning_tpu.parallel import (
+            partition as partition_lib,
+        )
+
+        self._placement = partition_lib.make_server_placement(
+            params, config.run.tp_size, config.run.tp_axis,
+            config.model.name,
+        )
+        if self._placement is not None:
+            params = self._placement.shard(params)
+            self._shapes_np = self._placement.shapes_tree()
+        else:
+            # Zero-memory shape/dtype stand-in (read-only broadcast views)
+            # for folder construction and recovery templates — the round
+            # loop no longer rebuilds a host params copy for them.
+            self._shapes_np = jax.tree.map(
+                lambda a: np.broadcast_to(
+                    np.zeros((), np.dtype(getattr(a, "dtype", np.float32))),
+                    np.shape(a)),
+                params,
+            )
         self.server_state = strategies.init_server_state(params, config.fed)
+        if self._placement is not None:
+            telemetry.get_registry().gauge(
+                "comm.server_bytes_per_chip").set(
+                    partition_lib.bytes_per_chip(self.server_state))
         self.history: list[dict] = []
         self._clients: dict[str, TensorClient] = {}
         self.trainers: list[DeviceInfo] = []
@@ -416,14 +449,15 @@ class FederatedCoordinator:
                 pruned = [d.device_id for d in share_failed]
                 cut = set(pruned)
                 cohort = [d for d in cohort if d.device_id not in cut]
-        with self.tracer.span("serialize_params"):
-            params_np = jax.tree.map(np.asarray, self.server_state.params)
+        with self.tracer.span("serialize_params"):  # colearn: hot
             # ONE encode + crc for the whole cohort (serialize-once): every
             # send below shares this read-only frame.  With compress_down
             # the frame is the server delta; ``resync_body`` lazily encodes
             # full params for workers whose cache missed the delta's base.
+            # The encoder reads (possibly sharded) params via PER-SHARD
+            # host reads — no full-tree gather on this path (CL012).
             body, resync_body, saved = self._downlink.encode_round(
-                r, params_np)
+                r, self.server_state.params)
         cohort_ids = sorted(int(d.device_id) for d in cohort)
         reg = telemetry.get_registry()
 
@@ -465,7 +499,8 @@ class FederatedCoordinator:
         # the StreamingFolder regardless of reply timing, so streaming
         # changes round records not at all — see StreamingFolder docstring.
         folder = StreamingFolder(
-            params_np, order=[str(int(d.device_id)) for d in cohort])
+            self._shapes_np, order=[str(int(d.device_id)) for d in cohort],
+            placement=self._placement)
         stale: list[str] = []
 
         def fold(dev: DeviceInfo, res) -> None:
@@ -963,6 +998,21 @@ class FederatedCoordinator:
                 (self.server_state, self._acct_rdp())
             )
             self.server_state, acct_rdp = state
+            if self._placement is not None:
+                # Restored leaves may come back as host arrays; re-place
+                # them on the server mesh so the resumed run keeps the
+                # sharded fold/update/encode plane (and its bitwise
+                # parity with the pre-crash rounds).
+                s = self.server_state
+                put = self._placement.shard
+                self.server_state = type(s)(
+                    params=put(s.params),
+                    opt_m=put(s.opt_m) if s.opt_m is not None else None,
+                    opt_v=put(s.opt_v) if s.opt_v is not None else None,
+                    control=(put(s.control) if s.control is not None
+                             else None),
+                    round_idx=s.round_idx,
+                )
             self.history = history
             if self.accountant is not None:
                 self.accountant.total_rdp = np.asarray(acct_rdp)

@@ -39,6 +39,7 @@ import numpy as np
 
 from colearn_federated_learning_tpu import telemetry
 from colearn_federated_learning_tpu.fed import compression
+from colearn_federated_learning_tpu.parallel import partition
 from colearn_federated_learning_tpu.utils.serialization import (
     pytree_to_bytes,
     wire_frame_length,
@@ -62,6 +63,25 @@ def apply_dense_delta(base: Any, delta: Any) -> Any:
                 + np.asarray(d, np.float32)).astype(b.dtype)
 
     return jax.tree.map(add, base, delta)
+
+
+def host_params(tree: Any) -> Any:  # colearn: hot
+    """Wire-side host view of the server params — the gather-free path.
+
+    Sharded ``jax.Array`` leaves (the PR 9 sharded server) are read
+    PER-SHARD straight off their devices into each leaf's host buffer
+    (``parallel.partition.host_leaf``): no device-side all-gather ever
+    materializes a replicated copy, no full-tree ``jax.device_get`` runs,
+    and on a multi-host mesh this is the only legal read.  The bytes the
+    per-chip replicated layout would have required are counted in
+    ``comm.gather_bytes_avoided_total``.  Host numpy trees (the replicated
+    coordinator) pass through byte-identically.
+    """
+    avoided = partition.tree_gather_avoided(tree)
+    if avoided:
+        telemetry.get_registry().counter(
+            "comm.gather_bytes_avoided_total").inc(avoided)
+    return partition.host_tree(tree)
 
 
 class DownlinkEncoder:
@@ -89,8 +109,15 @@ class DownlinkEncoder:
         (None when the scheme is off) lazily encodes — at most once — the
         full reconstructed params for workers that answered "resync";
         ``bytes_saved_per_send`` is the payload shrink a delta send
-        realizes over a full-params send."""
+        realizes over a full-params send.
+
+        ``params_np`` may be host numpy (replicated coordinator) or a
+        sharded ``jax.Array`` tree (sharded server): sharded leaves are
+        encoded from their device shards via :func:`host_params` — the
+        resulting frame is byte-for-byte the frame the gathered tree
+        would have produced (tests pin this)."""
         reg = telemetry.get_registry()
+        params_np = host_params(params_np)
         if self.scheme == "none":
             # Byte-identical to the per-request encode this path replaced.
             body = pytree_to_bytes(params_np, {"round": r})

@@ -109,9 +109,15 @@ class FederatedLearner:
         mesh = None
         if r.tp_size > 1 and len(devices) % r.tp_size != 0:
             # Non-divisible device counts would otherwise surface as an
-            # opaque reshape error inside make_mesh((-1, tp_size)).
+            # opaque reshape error inside make_mesh((-1, tp_size)).  The
+            # degradation is observable: a warning for interactive runs
+            # AND a labeled counter for dashboards/soaks — a fleet that
+            # silently runs replicated at tp_size=1 is a perf SLO bug.
             import warnings
 
+            telemetry.get_registry().counter(
+                "fed.mesh_fallback_total",
+                labels={"reason": "indivisible_devices"}).inc()
             warnings.warn(
                 f"tp_size={r.tp_size} needs a device count that is a "
                 f"multiple of it, have {len(devices)}; running without "

@@ -151,6 +151,20 @@ class FleetSim:
             zeros, config.fed.compress)
         self.up_frame_bytes = int(wire_frame_length(
             wire_up, {"round": 0, "op": "train", **meta_up}))
+        # Sharded-downlink shape (PR 9): with run.tp_size > 1 the server
+        # encodes each broadcast from per-device shards, never
+        # materializing a replicated copy.  The frame bytes are identical
+        # (same payload); what the estimator learns is the per-encode
+        # gather bytes AVOIDED — pure shape math from the partition rules,
+        # so 1M-cohort sweeps reflect the sharded wire cost without a mesh.
+        tp = config.run.tp_size
+        if tp > 1:
+            from colearn_federated_learning_tpu.parallel import partition
+            self.gather_avoided_bytes = int(partition.estimate_gather_avoided(
+                params_np, partition.rules_for_model(config.model.name),
+                config.run.tp_axis, tp))
+        else:
+            self.gather_avoided_bytes = 0
 
         reg = telemetry.get_registry()
         reg.gauge("fleetsim.devices").set(self.num_devices)
@@ -432,6 +446,13 @@ class FleetSim:
             bytes_up_est=bytes_up,
             **fstats,
         )
+        if self.gather_avoided_bytes:
+            # Key present only under a sharded server (tp_size > 1), so
+            # default round records stay byte-identical.  One broadcast
+            # encode per round → one per-encode avoidance charge.
+            out["bytes_gather_avoided_est"] = self.gather_avoided_bytes
+            reg.counter("fleetsim.bytes_gather_avoided_est_total").inc(
+                self.gather_avoided_bytes)
         if self._available_fraction_fn is not None:
             frac = self._available_fraction_fn(r)
             out["available_fraction"] = frac

@@ -6,6 +6,8 @@ is TPU-native, so scale comes from `jax.sharding` meshes instead:
 
 - ``mesh``:  named-axis mesh construction (clients × seq × model), ICI-first
   with a DCN-aware hybrid layout for multi-host pods.
+- ``partition``: regex-driven param partition rules → PartitionSpec trees,
+  shard/gather fns, and the sharded server-plane placement (PR 9).
 - ``ring``:  ring attention — blockwise attention with K/V blocks rotating
   around a mesh axis via ``lax.ppermute``, online-softmax accumulation; the
   long-context sequence-parallel primitive.
@@ -15,6 +17,17 @@ is TPU-native, so scale comes from `jax.sharding` meshes instead:
 from colearn_federated_learning_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     factor_devices,
+)
+from colearn_federated_learning_tpu.parallel.partition import (  # noqa: F401
+    CNN_RULES,
+    BERT_RULES,
+    DEFAULT_RULES,
+    TRANSFORMER_RULES,
+    ServerPlacement,
+    make_server_placement,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    rules_for_model,
 )
 from colearn_federated_learning_tpu.parallel.ring import (  # noqa: F401
     ring_attention,

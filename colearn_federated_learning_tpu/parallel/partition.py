@@ -1,0 +1,457 @@
+"""Regex-driven parameter partitioning: the sharded-server foundation.
+
+PR 9's refactor: the global model, optimizer state, and aggregation live
+SHARDED over a ``model`` mesh axis instead of replicated on every chip.
+This module is the single source of partition truth for all of it:
+
+- :func:`match_partition_rules` — an ordered ``(regex, spec)`` rule list
+  over '/'-joined flax param paths → a pytree of
+  :class:`~jax.sharding.PartitionSpec`.  First match wins (regex
+  precedence); scalars fall back to replicated; a dimension that does not
+  divide by its mesh-axis size replicates the whole leaf (GSPMD would
+  pad — replication keeps numerics exact, the parallel/tp.py contract).
+- :func:`make_shard_and_gather_fns` — per-leaf ``shard``/``gather``
+  closures for moving a pytree onto and off a mesh.
+- per-model rule sets: :data:`TRANSFORMER_RULES` (BERT/ViT qkv, MLP
+  up/down, vocab-sharded embedding, MoE expert banks — the same table
+  parallel/tp.py documents), :data:`CNN_RULES` (stem conv + dense head,
+  output-channel sharded), and :func:`rules_for_model` to pick one.
+- :class:`ServerPlacement` — the server-plane object the socket
+  coordinator holds: shard/scatter/assemble a params-shaped tree over a
+  1-D ``(model,)`` mesh so the streaming fold accumulates per-shard
+  slices (no replicated device intermediate) and the downlink encoder
+  reads device shards directly instead of ``jax.device_get`` of the
+  full tree.
+- :func:`host_tree` / :func:`leaf_gather_avoided` — per-shard host reads
+  (the multi-host-legal alternative to a full-tree gather) and the
+  bytes-of-replication-avoided accounting behind
+  ``comm.gather_bytes_avoided_total``.
+
+The rule grammar: each rule is ``(regex, spec)`` or ``(regex, spec,
+ndim)``.  ``spec`` is ``None`` (replicate), an ``int`` dimension
+(possibly negative) to shard over the default axis, or an explicit
+:class:`PartitionSpec` right-aligned to the leaf rank.  An optional
+``ndim`` restricts the rule to leaves of that exact rank (e.g. the
+vocab-sharded ``embedding`` rule must not grab 1-D norm params that
+happen to share the name).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def path_str(path) -> str:
+    """'/'-joined flax key path (``tree_map_with_path`` entries)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+# ---------------------------------------------------------------- rules --
+
+# Transformer models (models/bert.py, models/vit.py, MoE banks) — exactly
+# the parallel/tp.py table, expressed as ordered regex rules.
+TRANSFORMER_RULES: tuple = (
+    (r"experts", 0),                               # MoE bank: (E, ...)
+    (r"(^|/)embedding$", 0, 2),                    # vocab-sharded table
+    (r"(^|/)(query|key|value)/kernel$", -2),       # (D, H, hd) head dim
+    (r"(^|/)(query|key|value)/[^/]+$", 0),         # qkv bias (H, hd)
+    (r"(^|/)out/kernel$", 0, 3),                   # row parallel (H, hd, D)
+    (r"Block.*/Dense_0/kernel$", 1),               # MLP up (D, F)
+    (r"Block.*/Dense_0/[^/]+$", 0),                # MLP up bias (F,)
+    (r"Block.*/Dense_1/kernel$", 0),               # MLP down (F, D)
+    (r"", None),                                   # everything else
+)
+BERT_RULES = TRANSFORMER_RULES
+
+# CNN stem + dense head (models/cnn.py): shard the output-channel dim.
+# The server plane only ever runs ELEMENTWISE math on these (fold, server
+# optimizer), so any consistent sharding is numerics-exact.
+CNN_RULES: tuple = (
+    (r"Conv[^/]*/kernel$", -1),                    # HWIO: out channels
+    (r"Conv[^/]*/bias$", 0),
+    (r"Dense[^/]*/kernel$", -1),
+    (r"Dense[^/]*/bias$", 0),
+    (r"", None),
+)
+
+# Unknown models: try the transformer rules first, then the CNN ones.
+DEFAULT_RULES: tuple = TRANSFORMER_RULES[:-1] + CNN_RULES
+
+_TRANSFORMER_NAMES = ("bert", "vit", "transformer", "moe", "gpt")
+_CNN_NAMES = ("cnn", "conv", "mlp", "dense", "logreg", "linear")
+
+
+def rules_for_model(model_name: str) -> tuple:
+    """Pick the rule set for a registered model name."""
+    name = (model_name or "").lower()
+    if any(k in name for k in _TRANSFORMER_NAMES):
+        return TRANSFORMER_RULES
+    if any(k in name for k in _CNN_NAMES):
+        return CNN_RULES
+    return DEFAULT_RULES
+
+
+def _resolve_spec(spec, shape: tuple, axis: str,
+                  sizes: Mapping[str, int]) -> P:
+    """Turn one rule spec into a concrete PartitionSpec for ``shape``,
+    replicating whenever the sharded dim would not divide evenly."""
+    if spec is None:
+        return P()
+    if isinstance(spec, int):
+        d = spec + len(shape) if spec < 0 else spec
+        if not 0 <= d < len(shape):
+            return P()
+        size = sizes.get(axis, 0)
+        if size and shape[d] % size:
+            return P()           # not divisible → replicate, numerics exact
+        out = [None] * len(shape)
+        out[d] = axis
+        return P(*out)
+    # Explicit PartitionSpec, right-aligned to the leaf rank.
+    entries = tuple(spec)
+    pad = len(shape) - len(entries)
+    if pad < 0:
+        return P()
+    entries = (None,) * pad + entries
+    for d, name in enumerate(entries):
+        if name is None:
+            continue
+        for ax in (name if isinstance(name, tuple) else (name,)):
+            size = sizes.get(ax, 0)
+            if size and shape[d] % size:
+                return P()
+    return P(*entries)
+
+
+def match_partition_rules(
+    rules: Sequence[tuple],
+    params: Any,
+    *,
+    axis: str = "model",
+    sizes: Optional[Mapping[str, int]] = None,
+    mesh: Optional[Mesh] = None,
+) -> Any:
+    """Pytree of PartitionSpec for ``params`` from an ordered rule list.
+
+    First rule whose regex ``re.search``-matches the '/'-joined path (and
+    whose optional ``ndim`` constraint holds) wins.  Scalars are always
+    replicated.  Raises ``ValueError`` for a path no rule matches — rule
+    sets are expected to end with a catch-all ``(r"", None)``.
+    """
+    sizes = dict(sizes) if sizes is not None else (
+        dict(mesh.shape) if mesh is not None else {}
+    )
+    compiled = []
+    for rule in rules:
+        pat, spec = rule[0], rule[1]
+        ndim = rule[2] if len(rule) > 2 else None
+        compiled.append((re.compile(pat), spec, ndim))
+
+    def for_leaf(path, w):
+        shape = np.shape(w)
+        name = path_str(path)
+        if len(shape) == 0:
+            return P()           # scalar → replicated, regardless of rules
+        for pat, spec, ndim in compiled:
+            if ndim is not None and len(shape) != ndim:
+                continue
+            if pat.search(name):
+                return _resolve_spec(spec, shape, axis, sizes)
+        raise ValueError(
+            f"no partition rule matched param {name!r} (shape {shape}); "
+            "rule sets should end with a catch-all (r\"\", None)"
+        )
+
+    return jax.tree_util.tree_map_with_path(for_leaf, params)
+
+
+def make_shard_and_gather_fns(specs: Any, mesh: Mesh) -> tuple[Any, Any]:
+    """Per-leaf ``(shard_fns, gather_fns)`` trees for ``specs`` on ``mesh``.
+
+    ``shard_fns[leaf](x)`` places ``x`` with its NamedSharding;
+    ``gather_fns[leaf](x)`` reads it back to host numpy via per-shard
+    reads (:func:`host_leaf`) — legal on multi-host meshes where a plain
+    ``np.asarray`` of a non-fully-addressable array raises.
+    """
+    def make_pair(spec):
+        sharding = NamedSharding(mesh, spec)
+
+        def shard_fn(x, _s=sharding):
+            return jax.device_put(x, _s)
+
+        return shard_fn, host_leaf
+
+    pairs = jax.tree.map(make_pair, specs,
+                         is_leaf=lambda s: isinstance(s, P))
+    shard_fns = jax.tree.map(lambda pr: pr[0], pairs,
+                             is_leaf=lambda pr: isinstance(pr, tuple))
+    gather_fns = jax.tree.map(lambda pr: pr[1], pairs,
+                              is_leaf=lambda pr: isinstance(pr, tuple))
+    return shard_fns, gather_fns
+
+
+def shard_tree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """``device_put`` every leaf with its spec's NamedSharding."""
+    return jax.tree.map(
+        lambda w, s: jax.device_put(w, NamedSharding(mesh, s)),
+        tree, specs,
+    )
+
+
+# ------------------------------------------------------- host-side reads --
+
+def _index_key(index: tuple) -> tuple:
+    """Hashable key for a shard's global-index tuple (slices are
+    unhashable before 3.12)."""
+    return tuple((s.start, s.stop, s.step) for s in index)
+
+
+def host_leaf(a: Any) -> np.ndarray:
+    """One (possibly sharded) array → host numpy via its addressable
+    shards.  Never a device-side all-gather and never a full-array
+    ``jax.device_get``: each device contributes exactly its own shard
+    bytes, which is also the only legal read on a multi-host mesh."""
+    if not isinstance(a, jax.Array):
+        return np.asarray(a)
+    shards = a.addressable_shards
+    if len(shards) == 1:
+        return np.asarray(shards[0].data)
+    out = np.empty(a.shape, a.dtype)
+    seen = set()
+    for sh in shards:            # colearn: hot
+        key = _index_key(sh.index)
+        if key in seen:          # replicated copies: read once
+            continue
+        seen.add(key)
+        # per-shard D2H read IS the point: each chip syncs only its own
+        # slice, so there is no full-array transfer to batch after the loop
+        out[sh.index] = np.asarray(sh.data)  # colearn: noqa(CL006)
+    return out
+
+
+def host_tree(tree: Any) -> Any:
+    """Per-shard host read of a whole pytree (see :func:`host_leaf`)."""
+    return jax.tree.map(host_leaf, tree)
+
+
+def leaf_gather_avoided(a: Any) -> int:
+    """Bytes of per-chip replication a sharded leaf avoids: with ``n``
+    distinct shards each chip holds ``nbytes/n`` instead of ``nbytes``,
+    so a replicated layout (or the all-gather required to build one)
+    would move/materialize ``nbytes·(n−1)/n`` more per chip."""
+    if not isinstance(a, jax.Array):
+        return 0
+    try:
+        shards = a.addressable_shards
+    except Exception:
+        return 0
+    n = len({_index_key(sh.index) for sh in shards})
+    if n <= 1:
+        return 0
+    return int(a.nbytes) * (n - 1) // n
+
+
+def tree_gather_avoided(tree: Any) -> int:
+    return sum(leaf_gather_avoided(l) for l in jax.tree.leaves(tree))
+
+
+def estimate_gather_avoided(params: Any, rules: Sequence[tuple],
+                            axis: str, size: int) -> int:
+    """Pure shape math (no mesh, no devices): the per-chip replication
+    bytes a ``size``-way sharded server avoids for ``params`` under
+    ``rules`` — fleetsim's byte estimator for the sharded downlink."""
+    if size <= 1:
+        return 0
+    specs = match_partition_rules(rules, params, axis=axis,
+                                  sizes={axis: size})
+    total = 0
+    for w, s in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(specs,
+                                    is_leaf=lambda x: isinstance(x, P))):
+        if any(e == axis for e in s):
+            nbytes = int(np.prod(np.shape(w))) * np.dtype(
+                getattr(w, "dtype", np.float32)).itemsize
+            total += nbytes * (size - 1) // size
+    return total
+
+
+def bytes_per_chip(tree: Any) -> int:
+    """Max over devices of the bytes of ``tree`` resident on that chip
+    (per-shard accounting; replicated leaves charge every chip, host
+    numpy leaves charge one).  Deterministic on the forced-8-device CPU
+    mesh — the measured stand-in for ``memory_stats()`` (empty on CPU
+    backends) behind the mesh-smoke HBM sentinel."""
+    per: dict = {}
+    host = 0
+    for l in jax.tree.leaves(tree):
+        if isinstance(l, jax.Array):
+            for sh in l.addressable_shards:
+                per[sh.device] = per.get(sh.device, 0) + int(sh.data.nbytes)
+        elif hasattr(l, "nbytes"):
+            host += int(l.nbytes)
+    return (max(per.values()) if per else 0) + host
+
+
+# ------------------------------------------------------ server placement --
+
+class ServerPlacement:
+    """Sharded placement of the SERVER plane over a 1-D ``(model,)`` mesh.
+
+    The socket coordinator's round math is purely elementwise (weighted
+    fold, server optimizer), so slicing every tensor over the model axis
+    is bitwise-exact: a per-shard sum in cohort order produces exactly
+    the bytes of the full-leaf sum in the same order.  This object
+    precomputes each leaf's distinct ``(device, index)`` shard layout and
+    provides:
+
+    - :meth:`shard` — place a params-shaped tree sharded on the mesh;
+    - :meth:`slice_tree` — host-side scatter: each leaf → a tuple of its
+      per-shard numpy slices (the StreamingFolder staging format, so no
+      replicated device intermediate ever exists);
+    - :meth:`assemble` — per-shard slices → a sharded ``jax.Array`` tree
+      via ``make_array_from_single_device_arrays`` (every device receives
+      only its own shard bytes).
+    """
+
+    def __init__(self, mesh: Mesh, axis: str, specs: Any, params: Any):
+        if len(mesh.shape) != 1:
+            raise ValueError(
+                f"ServerPlacement wants a 1-D ({axis},) mesh, got axes "
+                f"{tuple(mesh.shape)}"
+            )
+        self.mesh = mesh
+        self.axis = axis
+        self.specs = specs
+        leaves, self.treedef = jax.tree.flatten(params)
+        spec_leaves = self.treedef.flatten_up_to(specs)
+        self._meta = []
+        devices = list(mesh.devices.flat)
+        self._dtypes = [np.dtype(getattr(w, "dtype", np.float32))
+                        for w in leaves]
+        for w, spec in zip(leaves, spec_leaves):
+            shape = tuple(np.shape(w))
+            sharding = NamedSharding(mesh, spec)
+            dmap = sharding.devices_indices_map(shape)
+            slices, seen = [], set()
+            for d in devices:
+                key = _index_key(tuple(
+                    s if isinstance(s, slice) else slice(None)
+                    for s in (dmap[d] or (slice(None),) * len(shape))
+                ))
+                if key in seen:
+                    continue
+                seen.add(key)
+                slices.append((d, dmap[d]))
+            self._meta.append((shape, spec, sharding, slices))
+
+    @classmethod
+    def from_params(cls, params: Any, mesh: Mesh, axis: str,
+                    rules: Sequence[tuple]) -> "ServerPlacement":
+        specs = match_partition_rules(rules, params, axis=axis,
+                                      sizes=dict(mesh.shape))
+        return cls(mesh, axis, specs, params)
+
+    @property
+    def n_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def sharded_fraction(self) -> float:
+        """Fraction of parameter COUNT living sharded (vs replicated)."""
+        tot = sharded = 0
+        for shape, spec, _, _ in self._meta:
+            n = int(np.prod(shape)) if shape else 1
+            tot += n
+            if any(e == self.axis for e in spec):
+                sharded += n
+        return sharded / max(tot, 1)
+
+    def shard(self, tree: Any) -> Any:
+        return shard_tree(tree, self.specs, self.mesh)
+
+    def slice_tree(self, tree: Any) -> Any:
+        """Each leaf → tuple of its distinct per-shard numpy slices (the
+        symmetric scatter of a full host tensor onto the shard layout)."""
+        leaves = self.treedef.flatten_up_to(tree)
+        out = []
+        for l, (shape, _, _, slices) in zip(leaves, self._meta):
+            arr = np.asarray(l)
+            out.append(tuple(
+                np.ascontiguousarray(arr[idx]) for _, idx in slices
+            ))
+        return jax.tree.unflatten(self.treedef, out)
+
+    def assemble(self, sliced: Any) -> Any:
+        """Per-shard slices (:meth:`slice_tree` layout) → sharded
+        ``jax.Array`` tree; each slice is placed on ITS device only."""
+        flat = jax.tree.leaves(sliced)
+        it = iter(flat)
+        out = []
+        for shape, spec, sharding, slices in self._meta:
+            parts = [next(it) for _ in slices]
+            if len(parts) == 1:
+                # Replicated leaf (single distinct shard): plain placement.
+                out.append(jax.device_put(np.asarray(parts[0]).reshape(
+                    shape if shape else ()), sharding))
+                continue
+            dtype = np.asarray(parts[0]).dtype
+            mesh_sharding = NamedSharding(self.mesh, spec)
+            arrays = [
+                jax.device_put(np.ascontiguousarray(p, dtype), d)
+                for p, (d, _) in zip(parts, slices)
+            ]
+            out.append(jax.make_array_from_single_device_arrays(
+                shape, mesh_sharding, arrays))
+        return jax.tree.unflatten(self.treedef, out)
+
+    def shapes_tree(self) -> Any:
+        """Host-side zero-memory shape/dtype stand-in for the params tree
+        (read-only broadcast views) — folder/recovery templates without
+        gathering the sharded arrays."""
+        out = [
+            np.broadcast_to(np.zeros((), dt), shape)
+            for (shape, _, _, _), dt in zip(self._meta, self._dtypes)
+        ]
+        return jax.tree.unflatten(self.treedef, out)
+
+
+def make_server_placement(
+    params: Any,
+    tp_size: int,
+    axis: str,
+    model_name: str,
+    devices: Optional[Iterable] = None,
+) -> Optional[ServerPlacement]:
+    """Build the coordinator's sharded-server placement, or ``None`` (with
+    a labeled ``fed.mesh_fallback_total`` count) when the host cannot
+    honor ``tp_size`` or the rules shard nothing of this model."""
+    from colearn_federated_learning_tpu import telemetry
+
+    if tp_size <= 1:
+        return None
+    devs = list(devices) if devices is not None else list(jax.devices())
+    reg = telemetry.get_registry()
+    if len(devs) < tp_size:
+        reg.counter("fed.mesh_fallback_total",
+                    labels={"reason": "insufficient_devices"}).inc()
+        return None
+    mesh = Mesh(np.array(devs[:tp_size]), (axis,))
+    placement = ServerPlacement.from_params(
+        params, mesh, axis, rules_for_model(model_name))
+    if placement.sharded_fraction() == 0.0:
+        reg.counter("fed.mesh_fallback_total",
+                    labels={"reason": "rules_matched_nothing"}).inc()
+        return None
+    return placement

@@ -45,59 +45,18 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        else:
-            parts.append(str(p))
-    return "/".join(parts)
-
-
-def _spec_for(path: str, shape: tuple[int, ...], axis: str, size: int):
-    """PartitionSpec for one param leaf (see module table)."""
-
-    def shard(dim: int):
-        if shape[dim] % size:
-            return P()  # not divisible → replicate, keep numerics exact
-        spec = [None] * len(shape)
-        spec[dim] = axis
-        return P(*spec)
-
-    leaf = path.rsplit("/", 1)[-1]
-    parent = path.rsplit("/", 2)[-2] if path.count("/") else ""
-
-    # MoE expert banks: stacked (E, ...) leaves under an "experts" module.
-    if "experts" in path:
-        return shard(0)
-    # Token embedding table: vocab-sharded (Megatron-style).  GSPMD turns
-    # the gather into a masked local lookup + all-reduce, keeping the
-    # biggest single leaf of the text models off every chip.
-    if leaf == "embedding" and len(shape) == 2:
-        return shard(0)
-    # Attention projections (models/attention.py DenseGeneral layout).
-    if parent in ("query", "key", "value"):
-        return shard(len(shape) - 2) if leaf == "kernel" else shard(0)
-    if parent == "out" and leaf == "kernel" and len(shape) == 3:
-        return shard(0)
-    # Transformer-block MLP (models/bert.py, models/vit.py: Dense_0 up,
-    # Dense_1 down inside each block).
-    if "Block" in path and parent == "Dense_0":
-        return shard(1) if leaf == "kernel" else shard(0)
-    if "Block" in path and parent == "Dense_1" and leaf == "kernel":
-        return shard(0)
-    return P()
+from colearn_federated_learning_tpu.parallel import partition
 
 
 def param_specs(params: Any, axis: str, size: int) -> Any:
-    """Pytree of :class:`PartitionSpec` matching ``params``' structure."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, w: _spec_for(_path_str(path), np.shape(w), axis, size),
-        params,
+    """Pytree of :class:`PartitionSpec` matching ``params``' structure.
+
+    Since PR 9 this is the regex rule engine in parallel/partition.py
+    (``TRANSFORMER_RULES`` encodes exactly the module table above) —
+    one source of partition truth shared with the sharded server plane.
+    """
+    return partition.match_partition_rules(
+        partition.TRANSFORMER_RULES, params, axis=axis, sizes={axis: size}
     )
 
 
@@ -110,9 +69,7 @@ def shard_params(params: Any, mesh: Mesh, axis: str) -> Any:
     """
     size = mesh.shape[axis]
     specs = param_specs(params, axis, size)
-    return jax.tree.map(
-        lambda w, s: jax.device_put(w, NamedSharding(mesh, s)), params, specs
-    )
+    return partition.shard_tree(params, specs, mesh)
 
 
 def sharded_fraction(params: Any, axis: str, size: int) -> float:

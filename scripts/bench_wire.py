@@ -33,6 +33,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Force a multi-device CPU host BEFORE jax initializes (same trick as
+# tests/conftest.py) so the --tp-sizes sweep has a mesh to shard the
+# server over; harmless for the tp_size=1 rows.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 from colearn_federated_learning_tpu import telemetry  # noqa: E402
 from colearn_federated_learning_tpu.utils.config import (  # noqa: E402
     DataConfig,
@@ -48,10 +57,12 @@ _COUNTERS = (
     "comm.bytes_sent",
     "comm.bytes_saved_downlink",
     "comm.resync_total",
+    "comm.gather_bytes_avoided_total",
 )
 
 
-def bench_config(n_workers: int, scheme: str) -> ExperimentConfig:
+def bench_config(n_workers: int, scheme: str,
+                 tp_size: int = 1) -> ExperimentConfig:
     """The bench CNN shape: a width-16 conv net on mnist_tiny — big enough
     (~100 kB of float32 params) that frame encode/copy costs are visible,
     small enough to compile and train in seconds on CPU."""
@@ -62,11 +73,12 @@ def bench_config(n_workers: int, scheme: str) -> ExperimentConfig:
         fed=FedConfig(strategy="fedavg", rounds=1, cohort_size=0,
                       local_steps=2, batch_size=16, lr=0.05, momentum=0.0,
                       compress_down=scheme),
-        run=RunConfig(name="bench_wire", backend="cpu", seed=0),
+        run=RunConfig(name="bench_wire", backend="cpu", seed=0,
+                      tp_size=tp_size),
     )
 
 
-def run_bench(n_workers: int, scheme: str, rounds: int,
+def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
               warmup_timeout: float, round_timeout: float) -> dict:
     from colearn_federated_learning_tpu.comm.broker import MessageBroker
     from colearn_federated_learning_tpu.comm.coordinator import (
@@ -80,7 +92,7 @@ def run_bench(n_workers: int, scheme: str, rounds: int,
     import jax
     import numpy as np
 
-    config = bench_config(n_workers, scheme)
+    config = bench_config(n_workers, scheme, tp_size)
     reg = telemetry.get_registry()
 
     broker = MessageBroker().start()
@@ -103,6 +115,10 @@ def run_bench(n_workers: int, scheme: str, rounds: int,
         # Frame length of a full-params broadcast: depends only on leaf
         # shapes/dtypes (+ a round digit or two of header JSON), so one
         # sample stands for every round.
+        from colearn_federated_learning_tpu.parallel import partition
+
+        server_bytes_per_chip = int(
+            partition.bytes_per_chip(coord.server_state))
         params_np = jax.tree.map(np.asarray, coord.server_state.params)
         full_len = wire_frame_length(params_np, {"round": 1, "down": "full"})
         # Uplink frame length under the configured update scheme: also
@@ -127,6 +143,8 @@ def run_bench(n_workers: int, scheme: str, rounds: int,
                 "bytes_sent": int(delta["comm.bytes_sent"]),
                 "bytes_saved": int(delta["comm.bytes_saved_downlink"]),
                 "resyncs": int(delta["comm.resync_total"]),
+                "gather_avoided": int(
+                    delta["comm.gather_bytes_avoided_total"]),
                 "sends": sends,
                 "round_time_s": rec["round_time_s"],
                 "fold_overlap_s": rec.get("phase_fold_overlap_s", 0.0),
@@ -150,7 +168,13 @@ def run_bench(n_workers: int, scheme: str, rounds: int,
         "dataset": "mnist_tiny",
         "cohort": n_workers,
         "scheme": scheme,
+        "tp_size": tp_size,
         "rounds": rounds,
+        # Sharded server (tp_size > 1): per-chip server-state bytes and
+        # the per-round gather bytes the shard-wise downlink never moved.
+        "server_bytes_per_chip": server_bytes_per_chip,
+        "gather_bytes_avoided_per_round": int(statistics.mean(
+            r["gather_avoided"] for r in per_round)),
         # Serialize-once: one broadcast encode per round, cohort-independent.
         "encodes_per_round": max(encodes),
         # The replaced path encoded the full model once PER REQUEST.
@@ -182,6 +206,10 @@ def main(argv=None) -> int:
                     help="comma-separated cohort sizes")
     ap.add_argument("--schemes", default="none,int8",
                     help="comma-separated compress_down schemes")
+    ap.add_argument("--tp-sizes", default="1,2",
+                    help="comma-separated server tp_size values; sizes > 1 "
+                         "shard the global model over a (model,) mesh and "
+                         "are swept on the 'none' scheme only")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "results", "wire_bench.jsonl"))
@@ -189,21 +217,30 @@ def main(argv=None) -> int:
     ap.add_argument("--round-timeout", type=float, default=60.0)
     args = ap.parse_args(argv)
 
+    tp_sizes = [int(t) for t in args.tp_sizes.split(",") if t]
     rows = []
     for n in (int(c) for c in args.cohorts.split(",") if c):
         for scheme in (s.strip() for s in args.schemes.split(",") if s):
-            t0 = time.time()
-            row = run_bench(n, scheme, args.rounds,
-                            args.warmup_timeout, args.round_timeout)
-            row["bench_wall_s"] = round(time.time() - t0, 1)
-            rows.append(row)
-            print(json.dumps({k: v for k, v in row.items()
-                              if k != "per_round"}))
-            if row["encodes_per_round"] != 1:
-                print(f"FAIL: {row['encodes_per_round']} broadcast encodes "
-                      f"per round at cohort {n} (want exactly 1)",
-                      file=sys.stderr)
-                return 1
+            # Sharded-server rows ride on the uncompressed scheme (the
+            # encode path is byte-identical either way; one sweep axis at
+            # a time keeps the matrix readable).
+            for tp in (tp_sizes if scheme == "none" else [1]):
+                t0 = time.time()
+                row = run_bench(n, scheme, tp, args.rounds,
+                                args.warmup_timeout, args.round_timeout)
+                row["bench_wall_s"] = round(time.time() - t0, 1)
+                rows.append(row)
+                print(json.dumps({k: v for k, v in row.items()
+                                  if k != "per_round"}))
+                if row["encodes_per_round"] != 1:
+                    print(f"FAIL: {row['encodes_per_round']} broadcast "
+                          f"encodes per round at cohort {n} (want exactly "
+                          "1)", file=sys.stderr)
+                    return 1
+                if tp > 1 and row["gather_bytes_avoided_per_round"] <= 0:
+                    print(f"FAIL: tp_size={tp} row avoided no gather bytes "
+                          "(sharded downlink not engaged)", file=sys.stderr)
+                    return 1
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:

@@ -41,6 +41,9 @@ def main() -> None:
     p.add_argument("--examples-per-client", type=int, default=64)
     p.add_argument("--rounds", type=int, default=20)
     p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--tp-size", type=int, default=1,
+                   help="model-axis size: shard the global model (and the "
+                        "server plane) over a (clients, model) mesh")
     p.add_argument("--stem", default="conv",
                    choices=["conv", "space_to_depth"],
                    help="CNN stem MFU lever (models/cnn.py)")
@@ -72,8 +75,8 @@ def main() -> None:
     )
 
     dev = jax.devices()[0]
-    print(f"[perf] device: {dev.device_kind} ({dev.platform})",
-          file=sys.stderr)
+    print(f"[perf] device: {dev.device_kind} ({dev.platform}) "
+          f"x{len(jax.devices())}", file=sys.stderr)
 
     config = ExperimentConfig(
         data=DataConfig(dataset="cifar10", num_clients=args.num_clients,
@@ -85,7 +88,7 @@ def main() -> None:
                       local_steps=args.local_steps, batch_size=args.batch,
                       lr=0.05, momentum=0.9),
         run=RunConfig(name="north_star", backend="auto",
-                      profile_dir=args.profile_dir),
+                      tp_size=args.tp_size, profile_dir=args.profile_dir),
     )
     dataset = data_registry.get_dataset(
         "cifar10", seed=0,
@@ -108,12 +111,35 @@ def main() -> None:
         learner.run_round()
     learner.finalize_history()                      # true device sync
 
-    mem = dev.memory_stats() or {}
+    from colearn_federated_learning_tpu.parallel import partition
+
+    # Report memory across the LEARNER'S MESH, not jax.devices()[0]: the
+    # round program runs (and with --tp-size, the model lives sharded)
+    # over every mesh chip, so chip 0 alone under-reports a multi-chip
+    # run exactly when the numbers matter most.
+    mesh_devices = (list(learner.mesh.devices.flat)
+                    if learner.mesh is not None else [dev])
+    stats = [d.memory_stats() or {} for d in mesh_devices]
+    mem = {
+        "bytes_in_use": max((s.get("bytes_in_use", 0) for s in stats),
+                            default=0),
+        "peak_bytes_in_use": max(
+            (s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))
+             for s in stats), default=0),
+        "bytes_limit": max((s.get("bytes_limit", 0) for s in stats),
+                           default=0),
+    }
+    # Measured per-chip server-state bytes (per-shard accounting) — the
+    # deterministic stand-in where memory_stats() is empty (CPU backends).
+    server_bytes_per_chip = partition.bytes_per_chip(learner.server_state)
+    gather_avoided = partition.tree_gather_avoided(
+        learner.server_state.params)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     tag = (f"perf_c{learner.cohort_size}_w{args.width}_n{args.num_clients}"
            f"_k{learner.num_steps}_b{args.batch}_e{args.examples_per_client}"
            f"{'_s2d' if args.stem == 'space_to_depth' else ''}"
            f"{'_nonorm' if args.norm == 'none' else ''}"
+           f"{f'_tp{args.tp_size}' if args.tp_size > 1 else ''}"
            f"{'_sync' if args.sync_per_round else ''}")
     out_path = args.out or os.path.join(repo, "results", f"{tag}.jsonl")
     out_dir = os.path.dirname(out_path)
@@ -130,6 +156,8 @@ def main() -> None:
         "device": dev.device_kind,
         "platform": dev.platform,
         "n_devices": len(jax.devices()),
+        "mesh_devices": len(mesh_devices),
+        "tp_size": learner.tp_size,
         "num_clients": args.num_clients,
         "cohort": learner.cohort_size,
         "local_steps": learner.num_steps,
@@ -141,8 +169,11 @@ def main() -> None:
         "build_s": round(build_s, 2),
         "compile_s": round(compile_s, 2),
         "cost_analysis_flops_per_round": flops_per_round,
-        "hbm_used_gb": round(mem.get("bytes_in_use", 0) / 2**30, 3),
-        "hbm_limit_gb": round(mem.get("bytes_limit", 0) / 2**30, 3),
+        "hbm_used_gb": round(mem["bytes_in_use"] / 2**30, 3),
+        "hbm_peak_per_chip_gb": round(mem["peak_bytes_in_use"] / 2**30, 3),
+        "hbm_limit_gb": round(mem["bytes_limit"] / 2**30, 3),
+        "server_bytes_per_chip": int(server_bytes_per_chip),
+        "gather_bytes_avoided": int(gather_avoided),
         "timing_mode": ("sync_per_round" if args.sync_per_round
                         else "pipelined"),
     })
@@ -177,9 +208,12 @@ def main() -> None:
         "local_steps": learner.num_steps,
         "batch": args.batch,
         "width": args.width,
+        "tp_size": learner.tp_size,
         "rounds_timed": args.rounds,
         "total_s": round(dt, 4),
         "rounds_per_sec": round(rps, 4),
+        "server_bytes_per_chip": int(server_bytes_per_chip),
+        "gather_bytes_avoided": int(gather_avoided),
         "client_samples_per_sec_per_chip": round(rps * samples_per_round, 1),
         "flops_per_round": flops_per_round,
         "model_flops_utilization": round(mfu, 4),

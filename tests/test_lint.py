@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL011), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL012), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -608,6 +608,75 @@ def test_cl011_suppression(tmp_path):
                 xs = mask_scalar(xs, key, me, p, rnd)
             return xs
     """, relpath="pkg/privacy/mod.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl012_flags_device_get_in_hot_wire_path(tmp_path):
+    res = run_lint(tmp_path, """
+        import jax
+
+        def encode_round(rnd, params, codec):
+            with codec.span("serialize"):  # colearn: hot
+                host = jax.device_get(params)
+            return codec.pack(rnd, host)
+    """, relpath="pkg/comm/downlink.py")
+    assert rule_ids(res) == ["CL012"]
+    assert res.exit_code == 1
+
+
+def test_cl012_flags_tree_map_asarray_gather(tmp_path):
+    # The full-tree gather idiom spelled via tree.map(np.asarray, ...):
+    # every leaf is pulled whole to one host buffer.
+    res = run_lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        def serialize(params, wire):  # colearn: hot
+            host = jax.tree.map(np.asarray, params)
+            return wire.pack(host)
+    """, relpath="pkg/comm/coordinator.py")
+    assert rule_ids(res) == ["CL012"]
+
+
+def test_cl012_allows_per_shard_reads_and_cold_paths(tmp_path):
+    # Per-shard host reads (the sanctioned replacement) don't trip it.
+    res = run_lint(tmp_path, """
+        import numpy as np
+
+        def host_read(a):
+            out = np.empty(a.shape, a.dtype)
+            for sh in a.addressable_shards:  # colearn: hot
+                out[sh.index] = np.asarray(sh.data)
+            return out
+    """, relpath="pkg/comm/downlink.py", rules=["CL012"])
+    assert res.findings == []
+    # Unmarked (cold) gather in comm/: eval paths may gather whole trees.
+    res = run_lint(tmp_path, """
+        import jax
+
+        def evaluate(params, batch):
+            return score(jax.device_get(params), batch)
+    """, relpath="pkg/comm/coordinator.py")
+    assert res.findings == []
+    # Hot gather OUTSIDE comm/: not CL012's business.
+    res = run_lint(tmp_path, """
+        import jax
+
+        def snapshot(params):  # colearn: hot
+            return jax.device_get(params)
+    """, relpath="pkg/ckpt/mod.py")
+    assert res.findings == []
+
+
+def test_cl012_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        def stage(delta, w):  # colearn: hot
+            host = jax.tree.map(np.asarray, delta)  # colearn: noqa(CL012)
+            return scale(host, w)
+    """, relpath="pkg/comm/aggregation.py")
     assert res.findings == [] and res.suppressed == 1
 
 

@@ -1,0 +1,203 @@
+"""parallel/partition.py: the regex rule engine behind the sharded server.
+
+Rule semantics (first-match-wins precedence, scalar/indivisible fallback
+to replicated, ndim constraints, per-model rule-set selection) plus the
+shard/gather closure roundtrip on a forced-CPU ``(model,)`` mesh — the
+partition layer every sharded-server test (test_sharded_server.py) and
+the mesh-smoke bench build on.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from colearn_federated_learning_tpu.parallel import partition
+
+
+def _bertish_params():
+    """Synthetic tree with flax-style transformer paths (the shapes the
+    TRANSFORMER_RULES table documents)."""
+    return {
+        "params": {
+            "Embed_0": {"embedding": np.arange(16 * 8, dtype=np.float32)
+                        .reshape(16, 8)},
+            "TransformerBlock_0": {
+                "attn": {
+                    "query": {"kernel": np.ones((8, 4, 2), np.float32),
+                              "bias": np.ones((4, 2), np.float32)},
+                    "out": {"kernel": np.ones((4, 2, 8), np.float32)},
+                },
+                "Dense_0": {"kernel": np.ones((8, 32), np.float32),
+                            "bias": np.ones((32,), np.float32)},
+                "Dense_1": {"kernel": np.ones((32, 8), np.float32)},
+                "LayerNorm_0": {"scale": np.ones((8,), np.float32)},
+            },
+            "step": np.zeros((), np.float32),
+        }
+    }
+
+
+def _specs(params, size=4, rules=partition.TRANSFORMER_RULES):
+    return partition.match_partition_rules(
+        rules, params, axis="model", sizes={"model": size})
+
+
+# ------------------------------------------------------- rule matching ----
+def test_transformer_rules_first_match_wins():
+    sp = _specs(_bertish_params())["params"]
+    blk = sp["TransformerBlock_0"]
+    # qkv kernel (D, H, hd): head dim (-2) — NOT the generic qkv rule
+    # below it, which would shard dim 0.  Ordering is the contract.
+    assert blk["attn"]["query"]["kernel"] == P(None, "model", None)
+    assert blk["attn"]["query"]["bias"] == P("model", None)
+    assert blk["attn"]["out"]["kernel"] == P("model", None, None)
+    assert sp["Embed_0"]["embedding"] == P("model", None)
+    assert blk["Dense_0"]["kernel"] == P(None, "model")
+    assert blk["Dense_0"]["bias"] == P("model")
+    assert blk["Dense_1"]["kernel"] == P("model", None)
+    # No specific rule: the trailing catch-all replicates.
+    assert blk["LayerNorm_0"]["scale"] == P()
+
+
+def test_scalar_always_replicated_even_when_a_rule_matches():
+    # A greedy rule that would shard dim 0 of everything: scalars still
+    # come back replicated (there is no dim to shard).
+    sp = partition.match_partition_rules(
+        ((r"", 0),), {"s": np.float32(3.0), "v": np.ones(8, np.float32)},
+        axis="model", sizes={"model": 4})
+    assert sp["s"] == P()
+    assert sp["v"] == P("model")
+
+
+def test_indivisible_dim_replicates_whole_leaf():
+    # 6 % 4 != 0: GSPMD would pad — we replicate instead (numerics exact).
+    sp = partition.match_partition_rules(
+        ((r"", -1),), {"w": np.ones((8, 6), np.float32)},
+        axis="model", sizes={"model": 4})
+    assert sp["w"] == P()
+    # Same leaf at a size that divides: sharded.
+    sp = partition.match_partition_rules(
+        ((r"", -1),), {"w": np.ones((8, 6), np.float32)},
+        axis="model", sizes={"model": 2})
+    assert sp["w"] == P(None, "model")
+
+
+def test_ndim_constraint_skips_wrong_rank():
+    # The vocab-embedding rule is pinned to ndim=2: a 1-D param that
+    # happens to be NAMED "embedding" must fall through to the catch-all.
+    sp = _specs({"pos": {"embedding": np.ones((16,), np.float32)}})
+    assert sp["pos"]["embedding"] == P()
+
+
+def test_no_match_raises_value_error():
+    with pytest.raises(ValueError, match="no partition rule matched"):
+        partition.match_partition_rules(
+            ((r"kernel$", 0),), {"odd": {"bias": np.ones(4, np.float32)}},
+            axis="model", sizes={"model": 2})
+
+
+def test_explicit_partitionspec_right_aligned():
+    # A P("model") rule on a 2-D leaf right-aligns: last dim sharded.
+    sp = partition.match_partition_rules(
+        ((r"", P("model")),), {"w": np.ones((4, 8), np.float32)},
+        axis="model", sizes={"model": 4})
+    assert sp["w"] == P(None, "model")
+
+
+def test_cnn_rules_shard_output_channels():
+    params = {"Conv_0": {"kernel": np.ones((3, 3, 1, 8), np.float32),
+                         "bias": np.ones((8,), np.float32)},
+              "Dense_0": {"kernel": np.ones((32, 4), np.float32)}}
+    sp = partition.match_partition_rules(
+        partition.CNN_RULES, params, axis="model", sizes={"model": 4})
+    assert sp["Conv_0"]["kernel"] == P(None, None, None, "model")
+    assert sp["Conv_0"]["bias"] == P("model")
+    assert sp["Dense_0"]["kernel"] == P(None, "model")
+
+
+def test_rules_for_model_selection():
+    assert partition.rules_for_model("bert") is partition.TRANSFORMER_RULES
+    assert partition.rules_for_model("moe_bert") is partition.TRANSFORMER_RULES
+    assert partition.rules_for_model("vit_b16") is partition.TRANSFORMER_RULES
+    assert partition.rules_for_model("cnn") is partition.CNN_RULES
+    assert partition.rules_for_model("mlp") is partition.CNN_RULES
+    assert partition.rules_for_model("tcn") is partition.DEFAULT_RULES
+    assert partition.rules_for_model("") is partition.DEFAULT_RULES
+    # Every published rule set ends with a catch-all: no tree can raise.
+    for rules in (partition.TRANSFORMER_RULES, partition.CNN_RULES,
+                  partition.DEFAULT_RULES):
+        assert re.compile(rules[-1][0]).search("anything/at/all")
+
+
+# --------------------------------------------------- mesh roundtrips ----
+@pytest.fixture(scope="module")
+def model_mesh4():
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("needs the forced 8-device CPU host")
+    return Mesh(np.array(devs[:4]), ("model",))
+
+
+def test_shard_and_gather_fns_roundtrip(model_mesh4):
+    params = _bertish_params()
+    specs = _specs(params)
+    shard_fns, gather_fns = partition.make_shard_and_gather_fns(
+        specs, model_mesh4)
+    sharded = jax.tree.map(lambda f, w: f(w), shard_fns, params)
+    qk = sharded["params"]["TransformerBlock_0"]["attn"]["query"]["kernel"]
+    assert len({partition._index_key(s.index)
+                for s in qk.addressable_shards}) == 4
+    back = jax.tree.map(lambda f, w: f(w), gather_fns, sharded)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_server_placement_slice_assemble_roundtrip(model_mesh4):
+    params = _bertish_params()
+    placement = partition.ServerPlacement.from_params(
+        params, model_mesh4, "model", partition.TRANSFORMER_RULES)
+    assert 0.0 < placement.sharded_fraction() < 1.0
+
+    sliced = placement.slice_tree(params)
+    assembled = placement.assemble(sliced)
+    host = partition.host_tree(assembled)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(host)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    # shapes_tree: dtype/shape template without touching device data.
+    tmpl = placement.shapes_tree()
+    for a, t in zip(jax.tree.leaves(params), jax.tree.leaves(tmpl)):
+        assert np.shape(a) == np.shape(t)
+        assert np.asarray(a).dtype == t.dtype
+
+
+def test_gather_avoided_accounting(model_mesh4):
+    params = _bertish_params()
+    placement = partition.ServerPlacement.from_params(
+        params, model_mesh4, "model", partition.TRANSFORMER_RULES)
+    sharded = placement.shard(params)
+    measured = partition.tree_gather_avoided(sharded)
+    assert measured > 0
+    # The pure shape-math estimator (fleetsim's) agrees with the measured
+    # per-shard accounting exactly.
+    est = partition.estimate_gather_avoided(
+        params, partition.TRANSFORMER_RULES, "model", 4)
+    assert est == measured
+    # Replicated host tree: nothing to avoid.
+    assert partition.tree_gather_avoided(params) == 0
+    assert partition.estimate_gather_avoided(
+        params, partition.TRANSFORMER_RULES, "model", 1) == 0
+
+
+def test_bytes_per_chip_sharded_below_replicated(model_mesh4):
+    params = _bertish_params()
+    placement = partition.ServerPlacement.from_params(
+        params, model_mesh4, "model", partition.TRANSFORMER_RULES)
+    sharded = placement.shard(params)
+    replicated = partition.shard_tree(
+        params, jax.tree.map(lambda _: P(), params), model_mesh4)
+    assert partition.bytes_per_chip(sharded) < \
+        partition.bytes_per_chip(replicated)
