@@ -39,9 +39,12 @@ COUNTERS = (
     "comm.retry_total",              # labeled per device: {device=<id>}
     "comm.reenroll_total",
     "comm.reconnect_failures_total",
-    # wire fast path (comm/downlink.py, comm/coordinator.py)
+    # wire fast path (comm/downlink.py, comm/coordinator.py,
+    # comm/aggregation.py)
     "comm.broadcast_encode_total",   # CLW1 encodes of a broadcast frame
     "comm.bytes_saved_downlink",     # delta vs full-params payload bytes
+    "comm.bytes_saved_uplink",       # compressed vs dense train-reply bytes
+    "comm.uplink_densify_avoided_total",  # contributions folded sparse (O(k))
     "comm.resync_total",             # worker cache misses → full re-send
     # sharded server plane (parallel/partition.py, comm/downlink.py):
     # per-chip replication bytes the gather-free downlink never
@@ -83,6 +86,7 @@ COUNTERS = (
     "fleetsim.bytes_up_est_total",     # wire-codec frame estimate, uplink
     "fleetsim.bytes_down_est_total",   # wire-codec frame estimate, downlink
     "fleetsim.bytes_gather_avoided_est_total",  # sharded-downlink estimate
+    "fleetsim.bytes_up_saved_est_total",  # uplink-codec savings estimate
     # runtime observability plane (telemetry/runtime.py, telemetry/flight.py)
     "telemetry.compile_total",       # labeled {fn=<name>}: distinct XLA sigs
     "telemetry.recompile_total",     # labeled {fn,reason=shape|dtype|structure}
@@ -102,6 +106,9 @@ GAUGES = (
     # accounting via parallel/partition.bytes_per_chip — deterministic
     # even where memory_stats() is empty)
     "comm.server_bytes_per_chip",
+    # uplink error feedback (comm/worker.py): norm of the carried
+    # compression residual — should stay bounded round over round
+    "fed.uplink_residual_norm",
     # live HBM sampling (telemetry/runtime.py; empty on CPU backends)
     "runtime.hbm_bytes_in_use",
     "runtime.hbm_bytes_limit",

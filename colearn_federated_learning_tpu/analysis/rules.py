@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL012).
+"""The colearn rule set (CL001–CL013).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -724,3 +724,72 @@ class FullTreeGatherInHotWirePath(Rule):
                     "the full tree to one host buffer per chip; read "
                     "per-device shards (partition.host_tree) or stage "
                     "per-shard slices instead")
+
+
+# ----------------------------------------------------------------- CL013 --
+@register
+class FullShapeMaterializeInHotAggregation(Rule):
+    """The sparse-native uplink fold (PR 10) stages topk contributions as
+    (indices, values) and scatter-adds them at finalize: per-contribution
+    host cost is O(k), not O(model).  Densifying a compressed update —
+    a ``decompress_delta`` call, or allocating a full-shape buffer
+    (``np.zeros`` / ``np.empty`` / ``np.full`` / ``*_like``) per update —
+    inside a ``# colearn: hot`` aggregation/wire region of the comm plane
+    reintroduces exactly the O(model)-per-client work the fast path
+    removed.  The once-per-round accumulator allocation at finalize is
+    fine (it is not hot); the int8 dequantize is inherently dense (every
+    entry carries signal) and keeps a justified noqa."""
+
+    id = "CL013"
+    title = "full-shape materialization on a hot aggregation path"
+    hint = ("stage sparse (indices, values) and scatter-add at finalize "
+            "(StreamingFolder._stage_topk / ServerPlacement."
+            "partition_flat_indices); mark an inherently-dense decode "
+            "with `# colearn: noqa(CL013)`")
+
+    _ALLOCATORS = {"np.zeros", "numpy.zeros", "np.empty", "numpy.empty",
+                   "np.full", "numpy.full", "np.zeros_like",
+                   "numpy.zeros_like", "np.full_like", "numpy.full_like",
+                   "jnp.zeros", "jnp.zeros_like"}
+    _REGIONS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.For, ast.While,
+                ast.With)
+
+    def _materialize(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        dotted = dotted_name(node.func)
+        if dotted.rsplit(".", 1)[-1] == "decompress_delta":
+            return (f"{dotted}() densifies a compressed update to full "
+                    "model shape")
+        if dotted in self._ALLOCATORS and node.args:
+            return f"{dotted}(...) allocates a full-shape buffer"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("comm"):
+            return
+        hot = ctx.hot_lines()
+        if not hot:
+            return
+        seen: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, self._REGIONS) and node.lineno in hot:
+                inners: Iterator[ast.AST] = ast.walk(node)
+            elif isinstance(node, ast.Call) and node.lineno in hot:
+                inners = iter((node,))
+            else:
+                continue
+            for inner in inners:
+                what = self._materialize(inner)
+                if what is None:
+                    continue
+                key = (inner.lineno, inner.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, inner,
+                    f"{what} inside a `# colearn: hot` aggregation path — "
+                    "O(model) host work per update; stage sparse "
+                    "(indices, values) and scatter-add at finalize "
+                    "(StreamingFolder._stage_topk)")

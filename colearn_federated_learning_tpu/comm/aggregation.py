@@ -21,6 +21,18 @@ import numpy as np
 from colearn_federated_learning_tpu.utils import pytrees
 
 
+class _SparseStage:
+    """One topk contribution staged sparse: per leaf (flatten order), a
+    list of ``(flat_idx, scaled_values, target_shape)`` triples — one per
+    shard under a ServerPlacement, exactly one otherwise.  Total staged
+    memory is O(k), never O(model)."""
+
+    __slots__ = ("leaves",)
+
+    def __init__(self, leaves: list):
+        self.leaves = leaves
+
+
 class UpdateFolder:
     """Accumulate weighted client deltas; ``mean()`` is None-safe."""
 
@@ -86,6 +98,18 @@ class StreamingFolder(UpdateFolder):
     shard bytes (no replicated device intermediate).  Per element the sum
     sequence is unchanged (same contributions, same cohort order), so the
     sharded fold is BITWISE identical to the replicated one.
+
+    TOPK contributions never densify (the uplink fast path): ``add``
+    stages the wire's ``(indices, values)`` scaled by the aggregation
+    weight — O(k) host work per update instead of O(model) — and
+    ``finalize`` scatter-adds them into the dense accumulator in cohort
+    order, bitwise identical to the densify-then-sum fold it replaces
+    (adding exact zeros is an IEEE no-op).  Under a placement the staged
+    indices are partitioned per shard with offset-adjusted coordinates
+    (``ServerPlacement.partition_flat_indices``), so the tp>1 sparse fold
+    stays bitwise equal to the replicated one.  ``densify_avoided``
+    counts contributions folded sparse (mirrored to the
+    ``comm.uplink_densify_avoided_total`` counter).
     """
 
     def __init__(self, shapes: Any, order: Optional[Sequence[str]] = None,
@@ -96,31 +120,115 @@ class StreamingFolder(UpdateFolder):
         self._placement = placement
         self.fold_s = 0.0
         self.folded_ids: list[str] = []
+        self.densify_avoided = 0
         self._finalized = False
 
     def add(self, meta: dict, delta: Any,  # colearn: hot
             weight: Optional[float] = None) -> float:
+        from colearn_federated_learning_tpu import telemetry
         from colearn_federated_learning_tpu.fed import compression
 
         if self._finalized:
             raise RuntimeError("StreamingFolder already finalized")
         t0 = time.perf_counter()
-        delta = compression.decompress_delta(delta, meta, shapes=self.shapes)
         w = float(meta.get("weight", 1.0)) if weight is None else float(weight)
-        # Wire deltas are host numpy straight off the decode — the asarray
-        # normalizes dtypes/views, it cannot touch a device.
-        contrib = pytrees.tree_scale(
-            jax.tree.map(np.asarray, delta), w)  # colearn: noqa(CL012)
-        if self._placement is not None:
-            # Shard-wise staging: each leaf becomes the tuple of its
-            # per-shard slices (uplink decode scattered symmetrically).
-            contrib = self._placement.slice_tree(contrib)
+        if meta.get("compress") == "topk":
+            # Sparse-native staging: the wire's (indices, values) stay
+            # sparse — O(k) copy + scale here, cohort-order scatter-add at
+            # finalize.  No full-shape tensor is materialized per update.
+            contrib = self._stage_topk(delta, w)
+            self.densify_avoided += 1
+            telemetry.get_registry().counter(
+                "comm.uplink_densify_avoided_total").inc()
+        else:
+            # int8 dequantize is inherently dense (every entry carries
+            # signal); "none" already arrives dense.
+            delta = compression.decompress_delta(  # colearn: noqa(CL013)
+                delta, meta, shapes=self.shapes)
+            # Wire deltas are host numpy straight off the decode — the
+            # asarray normalizes dtypes/views, it cannot touch a device.
+            contrib = pytrees.tree_scale(
+                jax.tree.map(np.asarray, delta), w)  # colearn: noqa(CL012)
+            if self._placement is not None:
+                # Shard-wise staging: each leaf becomes the tuple of its
+                # per-shard slices (uplink decode scattered symmetrically).
+                contrib = self._placement.slice_tree(contrib)
         cid = str(meta.get("client_id", len(self._staged)))
         self._staged[cid] = (w, contrib,
                              float(meta.get("mean_loss", 0.0)) * w)
         self.count += 1
         self.fold_s += time.perf_counter() - t0
         return w
+
+    def _stage_topk(self, wire_tree: Any, w: float) -> _SparseStage:
+        """Stage one topk wire tree as scaled (indices, values) — the
+        O(k) replacement for decompress + tree_scale (+ slice_tree under
+        a placement).  Scaling values before the scatter is bitwise
+        identical to scaling after densify: the elementwise f32 multiply
+        commutes with slicing, and the dense path's ``0.0 * w`` zeros are
+        exactly the ``np.zeros`` the scatter targets at finalize."""
+        from colearn_federated_learning_tpu.fed import compression
+
+        treedef = jax.tree.structure(self.shapes)
+        refs = jax.tree.leaves(self.shapes)
+        nodes = treedef.flatten_up_to(wire_tree)
+        sw = np.float32(w)
+        leaves = []
+        for pos, (node, ref) in enumerate(zip(nodes, refs)):
+            idx, vals, _ = compression.topk_leaf_arrays(node)
+            vals = vals * sw
+            if self._placement is not None:
+                leaves.append(
+                    self._placement.partition_flat_indices(pos, idx, vals))
+            else:
+                leaves.append([(idx, vals, tuple(np.shape(ref)))])
+        return _SparseStage(leaves)
+
+    def _scatter_fold(self, stage: _SparseStage) -> Any:
+        """Fold one sparse-staged contribution into the accumulator.
+
+        First contribution: densify by ASSIGNMENT into fresh zeros —
+        byte-identical to the dense path's decompress-then-scale leaf.
+        Later contributions: in-place scatter-add at the staged indices.
+        Untouched positions keep their accumulator bits; the dense path
+        adds an exact ``+0.0`` there, an IEEE no-op except that it would
+        normalize a ``-0.0`` accumulator entry to ``+0.0`` — a corner the
+        magnitude-topk codec never ships and the parity tests pin.
+
+        Accumulation stays in OWNED, C-contiguous host numpy (the dense
+        path's ``tree_add`` would hand back immutable jax buffers), so
+        the in-place scatter is safe; a non-writable leaf (only possible
+        when schemes are mixed within one cohort, which no config
+        produces) is copied once before the scatter."""
+        treedef = jax.tree.structure(self.shapes)
+        if self.wsum is None:
+            out = []
+            for shards in stage.leaves:
+                parts = []
+                for idx, vals, shape in shards:
+                    flat = np.zeros(
+                        int(np.prod(shape, dtype=np.int64)), np.float32)
+                    flat[idx] = vals
+                    parts.append(flat.reshape(shape))
+                out.append(tuple(parts) if self._placement is not None
+                           else parts[0])
+            return jax.tree.unflatten(treedef, out)
+        acc_leaves = treedef.flatten_up_to(self.wsum)
+        new_leaves = []
+        for acc, shards in zip(acc_leaves, stage.leaves):
+            sharded = isinstance(acc, tuple)
+            targets = list(acc) if sharded else [acc]
+            for j, (arr, (idx, vals, _)) in enumerate(zip(targets, shards)):
+                if not (isinstance(arr, np.ndarray) and arr.flags.writeable
+                        and arr.flags.c_contiguous):
+                    arr = np.array(arr, np.float32)
+                # reshape(-1) of a C-contiguous array is a VIEW — the +=
+                # mutates the accumulator (and handles 0-d leaves, which
+                # reject direct fancy indexing).
+                arr.reshape(-1)[idx] += vals
+                targets[j] = arr
+            new_leaves.append(tuple(targets) if sharded else targets[0])
+        return jax.tree.unflatten(treedef, new_leaves)
 
     def finalize(self) -> None:
         """Sum the staged contributions in cohort order (idempotent).
@@ -135,10 +243,13 @@ class StreamingFolder(UpdateFolder):
         ids += [cid for cid in self._staged if cid not in ids]
         for cid in ids:
             w, contrib, loss_w = self._staged[cid]
-            self.wsum = (
-                contrib if self.wsum is None
-                else pytrees.tree_add(self.wsum, contrib)
-            )
+            if isinstance(contrib, _SparseStage):
+                self.wsum = self._scatter_fold(contrib)
+            else:
+                self.wsum = (
+                    contrib if self.wsum is None
+                    else pytrees.tree_add(self.wsum, contrib)
+                )
             self.total_w += w
             self.loss_sum += loss_w
         self.folded_ids = ids

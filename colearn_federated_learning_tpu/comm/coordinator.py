@@ -173,6 +173,27 @@ class FederatedCoordinator:
         # Round-broadcast encoder: serialize-once, optional downlink delta
         # compression (fed.compress_down; "none" keeps the wire identical).
         self._downlink = DownlinkEncoder(config.fed.compress_down)
+        # Uplink byte accounting, priced ONCE: frame lengths depend only on
+        # leaf shapes/dtypes (never values), so one zeros sample gives the
+        # per-update bytes a compressed uplink saves vs the dense frame —
+        # the same invariant the wire bench measures against.
+        self._uplink_saved_per_update = 0
+        if config.fed.compress != "none":
+            from colearn_federated_learning_tpu.fed import compression
+            from colearn_federated_learning_tpu.utils.serialization import (
+                wire_frame_length,
+            )
+
+            zeros = jax.tree.map(
+                lambda a: np.zeros(np.shape(a), np.float32), self._shapes_np)
+            dense_len = wire_frame_length(
+                zeros, {"round": 0, "op": "train", "compress": "none"})
+            wire_up, meta_up = compression.compress_delta(
+                zeros, config.fed.compress,
+                topk_fraction=config.fed.topk_fraction)
+            comp_len = wire_frame_length(
+                wire_up, {"round": 0, "op": "train", **meta_up})
+            self._uplink_saved_per_update = max(0, int(dense_len - comp_len))
         self._ckpt = None
         # Round WAL rides next to the orbax checkpoint: one fsynced JSON
         # line per round (counter + accepted-update manifest), the durable
@@ -489,6 +510,9 @@ class FederatedCoordinator:
                 reg.counter("comm.bytes_saved_downlink").inc(saved)
             if header.get("status") != "ok":
                 raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
+            if self._uplink_saved_per_update:
+                reg.counter("comm.bytes_saved_uplink").inc(
+                    self._uplink_saved_per_update)
             return header["meta"], delta
 
         from colearn_federated_learning_tpu.comm.aggregation import (
@@ -606,6 +630,12 @@ class FederatedCoordinator:
             # Key only present when the quorum feature is on, so default
             # round records stay byte-identical.
             rec["skipped_quorum"] = skipped_quorum
+        if self.config.fed.compress != "none":
+            # Uplink fast-path accounting; keys only present when an
+            # uplink codec is on (same byte-identical-record convention).
+            rec["bytes_saved_uplink"] = (self._uplink_saved_per_update
+                                         * folded)
+            rec["uplink_densify_avoided"] = folder.densify_avoided
         if self.accountant is not None:
             # Workers calibrate per-client noise to the NOMINAL cohort
             # (fed/setup.py finalize_client_delta), so with only ``folded``

@@ -88,6 +88,12 @@ class DeviceWorker:
                 "are dense gaussian-scale payloads, and lossy compression "
                 "would break the pairwise mask cancellation"
             )
+        if c.fed.secure_agg and c.fed.compress_feedback:
+            raise ValueError(
+                "secure_agg cannot carry uplink error feedback: masked "
+                "updates are dense by construction, so there is no "
+                "compression residual to feed back"
+            )
         if c.fed.secure_agg_key_exchange not in ("dh", "shared_seed"):
             raise ValueError(
                 "secure_agg_key_exchange must be 'dh' or 'shared_seed', "
@@ -175,6 +181,13 @@ class DeviceWorker:
         # Last-applied global params, engaged the first time a broadcast
         # carries a downlink mode (coordinator runs compress_down).
         self._param_cache: Optional[downlink.WorkerParamCache] = None
+        # Uplink error-feedback residual (fed.compress_feedback): what the
+        # last round's codec dropped, carried into the next delta before
+        # compression — symmetric to the downlink encoder's
+        # reconstruction-base feedback.  None until the first lossy
+        # compress; reset on resync/param-cache miss (a stale residual
+        # belongs to an update the server never folded).
+        self._uplink_residual: Optional[Any] = None
 
     # ------------------------------------------------------------------
     @property
@@ -558,7 +571,10 @@ class DeviceWorker:
             if full is None:
                 # Explicit cache-miss reply: the coordinator re-sends full
                 # params (comm.resync_total) instead of this device
-                # training on garbage or silently dropping out.
+                # training on garbage or silently dropping out.  The
+                # feedback residual belongs to an update that never made
+                # it into the fold — drop it with the stale base.
+                self._uplink_residual = None
                 return ({"status": "resync",
                          "error": f"client {self.client_id} has no cached "
                                   f"base for round {round_idx} delta"},
@@ -633,13 +649,27 @@ class DeviceWorker:
             # Per-client loss is exactly the statistic the masks hide;
             # ship it only on the unmasked plane.
             meta["mean_loss"] = float(result.mean_loss)
+        from colearn_federated_learning_tpu import telemetry
         from colearn_federated_learning_tpu.fed import compression
+        from colearn_federated_learning_tpu.utils import pytrees
 
-        with self.tracer.span("compress_delta",
-                              codec=self.config.fed.compress):
-            wire, cmeta = compression.compress_delta(
-                jax.tree.map(np.asarray, delta), self.config.fed.compress
-            )
+        fed = self.config.fed
+        feedback = (fed.compress_feedback and not fed.secure_agg
+                    and fed.compress != "none")
+        with self.tracer.span("compress_delta", codec=fed.compress):
+            delta_np = jax.tree.map(np.asarray, delta)
+            if feedback:
+                wire, cmeta, self._uplink_residual = (
+                    compression.feedback_compress(
+                        delta_np, self._uplink_residual, fed.compress,
+                        topk_fraction=fed.topk_fraction))
+                telemetry.get_registry().gauge(
+                    "fed.uplink_residual_norm").set(float(
+                        pytrees.tree_global_norm(self._uplink_residual)))
+            else:
+                wire, cmeta = compression.compress_delta(
+                    delta_np, fed.compress,
+                    topk_fraction=fed.topk_fraction)
         meta.update(cmeta)
         return ({"meta": meta}, wire)
 

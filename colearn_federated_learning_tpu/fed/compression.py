@@ -1,25 +1,35 @@
 """Update compression for the cross-process planes.
 
 The reference ships full-precision state_dicts over websockets; at the
-edge, update size is the round bottleneck.  The rebuild compresses client
-DELTAS (not params — deltas are small-range and quantize well):
+edge, update size is the round bottleneck.  The rebuild compresses
+DELTAS (not params — deltas are small-range and quantize well) on both
+wire directions: ``FedConfig.compress`` is the UPLINK codec (worker
+replies, comm/worker.py, and offline update files), ``compress_down``
+the DOWNLINK codec (coordinator broadcast, comm/downlink.py).  Both ends
+carry error feedback: the downlink encoder tracks a reconstruction base
+(PR 4), and the uplink worker carries the compression residual across
+rounds via :func:`feedback_compress` (``FedConfig.compress_feedback``).
 
 - ``int8``: per-leaf symmetric linear quantization — float32 payloads
   shrink ~4x, each leaf replaced by ``{"q": int8[...], "s": scale}``.
   Quantization error per round is O(scale/127); FedAvg's averaging
   further shrinks it by the cohort size.
 - ``topk``: per-leaf magnitude sparsification — only the largest
-  ``TOPK_FRACTION`` of entries survive, shipped as ``{"i": int32 indices,
+  ``topk_fraction`` of entries survive, shipped as ``{"i": int32 indices,
   "v": float32 values, "n": size}`` (8 bytes/kept entry → ~10x at the
   default 5% density).  The standard sparsification baseline (Aji &
-  Heafield 2017 pattern, PAPERS.md — pattern only); biased, but FedAvg's
-  cohort averaging recovers most of the signal and the wire planes are
-  where the bytes matter.
+  Heafield 2017 pattern, PAPERS.md — pattern only); biased on its own,
+  but uplink error feedback re-injects what sparsification dropped, so
+  density becomes a bytes/latency knob instead of a bias cap.  Topk
+  frames are also the SPARSE-NATIVE fold format: the coordinator's
+  StreamingFolder stages ``(indices, values)`` via
+  :func:`topk_leaf_arrays` and scatter-adds at finalize — O(k) host work
+  per contribution, never densifying on the hot path
+  (comm/aggregation.py).
 - ``none``: passthrough.
 
-Only the WIRE/FILE planes compress (comm/worker.py replies, offline update
-files).  The on-device engine never needs to — its aggregation is a psum,
-no serialization involved.  Config: ``FedConfig.compress``.
+The on-device engine never compresses — its aggregation is a psum, no
+serialization involved.
 """
 
 from __future__ import annotations
@@ -42,9 +52,28 @@ def _is_kleaf(node: Any) -> bool:
     return isinstance(node, dict) and set(node) == {_I, _V, _N}
 
 
-def compress_delta(delta: Any, scheme: str) -> tuple[Any, dict]:
+def topk_leaf_arrays(node: Any) -> tuple[np.ndarray, np.ndarray, int]:
+    """Split one topk wire leaf into ``(indices, float32 values, size)``.
+
+    The sparse-native consumers' accessor: comm/aggregation.py stages
+    these without ever materializing the dense leaf.  ``size`` is the
+    flat element count of the original leaf."""
+    if not _is_kleaf(node):
+        raise TypeError(f"unexpected node {type(node).__name__} in topk tree")
+    # _N may arrive off the wire as a 1-element array (see decompress).
+    n = int(np.asarray(node[_N]).ravel()[0])
+    return np.asarray(node[_I]), np.asarray(node[_V], np.float32), n
+
+
+def compress_delta(
+    delta: Any, scheme: str, *, topk_fraction: float | None = None
+) -> tuple[Any, dict]:
     """Returns (wire_tree, meta_fields) — a nested dict the CLW1/npz
-    codecs serialize directly."""
+    codecs serialize directly.
+
+    ``topk_fraction`` overrides the default keep density for the topk
+    scheme (``FedConfig.topk_fraction`` threads through here); ignored
+    by the other schemes."""
     import jax
 
     if scheme == "none":
@@ -63,10 +92,12 @@ def compress_delta(delta: Any, scheme: str) -> tuple[Any, dict]:
     if scheme == "topk":
         from colearn_federated_learning_tpu import native
 
+        frac = TOPK_FRACTION if topk_fraction is None else float(topk_fraction)
+
         def k_of(leaf):
             flat = np.asarray(leaf, np.float32).ravel()
             # Keep at least one entry so tiny biases/scalars survive.
-            k = max(1, int(np.ceil(flat.size * TOPK_FRACTION)))
+            k = max(1, int(np.ceil(flat.size * frac)))
             # Thread-parallel selection when the C++ library is present
             # (native/src/topk.cpp); numpy argpartition otherwise.
             idx, val = native.topk_abs(flat, k)
@@ -125,3 +156,32 @@ def decompress_delta(wire_tree: Any, meta: dict, shapes: Any = None) -> Any:
             treedef, [unk(n, r) for n, r in zip(nodes, refs)]
         )
     raise ValueError(f"unknown compression {scheme!r}")
+
+
+def feedback_compress(
+    delta: Any,
+    residual: Any,
+    scheme: str,
+    *,
+    topk_fraction: float | None = None,
+) -> tuple[Any, dict, Any]:
+    """Error-feedback compression (EF-SGD pattern): fold the carried
+    ``residual`` into ``delta``, compress the compensated tree, and
+    return what the codec dropped as the next round's residual.
+
+    Returns ``(wire_tree, meta_fields, new_residual)``.  The residual is
+    a host-numpy float32 pytree (``None`` for a lossless scheme, and
+    accepted as ``None`` on the first round / after a resync reset).
+    The caller carries it across rounds; symmetric to the downlink
+    encoder's reconstruction-base feedback (comm/downlink.py)."""
+    import jax
+
+    delta = jax.tree.map(lambda l: np.asarray(l, np.float32), delta)
+    if residual is not None:
+        delta = jax.tree.map(np.add, delta, residual)
+    wire, meta = compress_delta(delta, scheme, topk_fraction=topk_fraction)
+    if scheme == "none":
+        return wire, meta, None
+    recon = decompress_delta(wire, meta, shapes=delta)
+    new_residual = jax.tree.map(np.subtract, delta, recon)
+    return wire, meta, new_residual

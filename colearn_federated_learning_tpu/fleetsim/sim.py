@@ -148,9 +148,19 @@ class FleetSim:
             self.down_frame_bytes = int(wire_frame_length(
                 wire, {"round": 0, "down": "delta", **meta}))
         wire_up, meta_up = compression.compress_delta(
-            zeros, config.fed.compress)
+            zeros, config.fed.compress,
+            topk_fraction=config.fed.topk_fraction)
         self.up_frame_bytes = int(wire_frame_length(
             wire_up, {"round": 0, "op": "train", **meta_up}))
+        # Uplink fast-path savings (PR 10): per-update bytes a compressed
+        # uplink saves vs the dense train frame — same shape-only pricing
+        # the coordinator's comm.bytes_saved_uplink counter uses.
+        if config.fed.compress == "none":
+            self.up_saved_bytes = 0
+        else:
+            dense_up = int(wire_frame_length(
+                zeros, {"round": 0, "op": "train", "compress": "none"}))
+            self.up_saved_bytes = max(0, dense_up - self.up_frame_bytes)
         # Sharded-downlink shape (PR 9): with run.tp_size > 1 the server
         # encodes each broadcast from per-device shards, never
         # materializing a replicated copy.  The frame bytes are identical
@@ -453,6 +463,13 @@ class FleetSim:
             out["bytes_gather_avoided_est"] = self.gather_avoided_bytes
             reg.counter("fleetsim.bytes_gather_avoided_est_total").inc(
                 self.gather_avoided_bytes)
+        if self.up_saved_bytes:
+            # Uplink codec on (fed.compress != "none"): same conditional-
+            # key convention as above.
+            bytes_up_saved = n_reporting * self.up_saved_bytes
+            out["bytes_up_saved_est"] = bytes_up_saved
+            reg.counter("fleetsim.bytes_up_saved_est_total").inc(
+                bytes_up_saved)
         if self._available_fraction_fn is not None:
             frac = self._available_fraction_fn(r)
             out["available_fraction"] = frac

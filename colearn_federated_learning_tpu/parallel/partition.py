@@ -393,6 +393,41 @@ class ServerPlacement:
             ))
         return jax.tree.unflatten(self.treedef, out)
 
+    def partition_flat_indices(
+        self, leaf_pos: int, idx: np.ndarray, vals: np.ndarray,
+    ) -> list[tuple[np.ndarray, np.ndarray, tuple]]:
+        """Sparse counterpart of :meth:`slice_tree` for one leaf: scatter
+        flat ``(indices, values)`` onto the shard layout without ever
+        densifying.
+
+        ``leaf_pos`` is the leaf's flatten-order position; ``idx`` holds
+        flat (raveled) indices into the full leaf.  Returns one
+        ``(local_flat_idx, values, shard_shape)`` per distinct shard, in
+        :meth:`slice_tree`'s slice order, with indices offset-adjusted to
+        the shard's coordinate frame — so scattering each piece into
+        ``zeros(shard_shape)`` reproduces exactly the slice the dense
+        path would have cut from a full scatter."""
+        shape, _, _, slices = self._meta[leaf_pos]
+        idx = np.asarray(idx, np.int64)
+        if len(slices) == 1 or not shape:
+            # Replicated (or scalar) leaf: the single shard IS the leaf.
+            return [(idx, vals, shape)]
+        multi = np.unravel_index(idx, shape)
+        out = []
+        for _, index in slices:
+            starts = [0 if s.start is None else int(s.start) for s in index]
+            stops = [shape[d] if s.stop is None else int(s.stop)
+                     for d, s in enumerate(index)]
+            sub_shape = tuple(b - a for a, b in zip(starts, stops))
+            mask = np.ones(idx.shape, bool)
+            for d in range(len(shape)):
+                mask &= (multi[d] >= starts[d]) & (multi[d] < stops[d])
+            local = np.ravel_multi_index(
+                tuple(m[mask] - s for m, s in zip(multi, starts)), sub_shape
+            )
+            out.append((local.astype(np.int64), vals[mask], sub_shape))
+        return out
+
     def assemble(self, sliced: Any) -> Any:
         """Per-shard slices (:meth:`slice_tree` layout) → sharded
         ``jax.Array`` tree; each slice is placed on ITS device only."""

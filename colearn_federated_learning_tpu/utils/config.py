@@ -71,9 +71,24 @@ def validate_robustness(config: "ExperimentConfig") -> None:
         )
     from colearn_federated_learning_tpu.fed.compression import SCHEMES
 
+    if fed.compress not in SCHEMES:
+        raise ValueError(
+            f"unknown compress {fed.compress!r} (use {SCHEMES})"
+        )
     if fed.compress_down not in SCHEMES:
         raise ValueError(
             f"unknown compress_down {fed.compress_down!r} (use {SCHEMES})"
+        )
+    if not 0.0 < fed.topk_fraction <= 1.0:
+        raise ValueError(
+            f"topk_fraction must be in (0, 1], got {fed.topk_fraction}"
+        )
+    if fed.secure_agg and fed.compress_feedback:
+        raise ValueError(
+            "secure_agg cannot carry uplink error feedback: masked updates "
+            "are dense by construction (lossy compression would break the "
+            "pairwise mask cancellation), so there is no compression "
+            "residual to feed back"
         )
 
 
@@ -186,8 +201,22 @@ class FedConfig:
     # bigger coalition to break a dead client's masks; 0.5 matches the
     # Bonawitz honest-majority setting.
     secure_agg_threshold: float = 0.5
-    # Update compression on the wire/file planes (fed/compression.py).
+    # UPLINK update compression on the wire/file planes
+    # (fed/compression.py): workers compress their delta before it rides
+    # the socket; the coordinator's StreamingFolder folds topk frames
+    # sparse-natively (O(k) per contribution, comm/aggregation.py).
     compress: str = "none"            # none | int8 | topk
+    # UPLINK error feedback (comm/worker.py): carry the compression
+    # residual (delta - decompress(compress(delta))) into the next
+    # round's delta before compressing — symmetric to the downlink
+    # encoder's reconstruction-base feedback.  Only engages when
+    # ``compress`` is lossy; reset on resync/param-cache miss; rejected
+    # under secure_agg (masked updates are dense by construction).
+    compress_feedback: bool = False
+    # Topk keep density (fraction of entries kept per leaf) for the
+    # UPLINK codec.  Feedback de-biases sparsification, which makes the
+    # density a real accuracy/bytes knob rather than a fixed bias cap.
+    topk_fraction: float = 0.05
     # DOWNLINK compression (synchronous coordinator broadcast): ship the
     # server delta through the same codecs against a worker-side param
     # cache (comm/downlink.py).  "none" keeps the broadcast byte-identical

@@ -24,6 +24,12 @@ and the grouped-vs-flat pair ratio that pins the absence of an
 O(cohort²) term.  The [tool.colearn.slo] sentinel bounds the new
 columns.
 
+``--uplink-sweep`` adds ``fleet_uplink_bytes`` rows: analytic uplink
+frame bytes per fed.compress scheme (none/int8/topk) at
+``--uplink-devices`` reporting clients — the same shape-only wire
+pricing fleetsim's ``bytes_up_est`` / ``bytes_up_saved_est`` use, so
+the sentinel can gate the 1M-device uplink bill without a 1M fleet.
+
 Usage (CPU):
     JAX_PLATFORMS=cpu python scripts/bench_fleet.py
     JAX_PLATFORMS=cpu python scripts/bench_fleet.py \\
@@ -83,9 +89,29 @@ MASK_ROW_SCHEMA = {
     "bench_wall_s": float,
 }
 
+# Uplink wire-cost rows (--uplink-sweep): analytic per-scheme uplink
+# frame bytes at fleet scale — the same shape-only pricing the fleetsim
+# estimator (fleetsim/sim.py) and the coordinator's
+# comm.bytes_saved_uplink counter use, so the 1M-device point never has
+# to materialize a fleet.
+UPLINK_ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "scheme": str,
+    "topk_fraction": float,
+    "param_count": int,
+    "up_frame_bytes": int,
+    "up_dense_bytes": int,
+    "bytes_up_est_total": int,
+    "bytes_up_saved_est_total": int,
+    "uplink_reduction_x": float,
+    "bench_wall_s": float,
+}
+
 SCHEMAS = {
     "fleet_round": ROW_SCHEMA,
     "fleet_mask_cost": MASK_ROW_SCHEMA,
+    "fleet_uplink_bytes": UPLINK_ROW_SCHEMA,
 }
 
 
@@ -158,13 +184,11 @@ def run_point(cohort: int, rounds: int, chunk: int, seed: int) -> dict:
     }
 
 
-def bench_param_count(seed: int) -> int:
-    """Parameter count of the bench model — initialized once against a
+def bench_params(seed: int):
+    """Parameter tree of the bench model — initialized once against a
     tiny throwaway population (the model is devices-independent, so the
-    1M-cohort mask sweep never has to materialize a 1M fleet)."""
-    import jax
+    1M-cohort mask and uplink sweeps never materialize a 1M fleet)."""
     import jax.numpy as jnp
-    import numpy as np
 
     from colearn_federated_learning_tpu import fleetsim
     from colearn_federated_learning_tpu.fed import setup as setup_lib
@@ -180,10 +204,60 @@ def bench_param_count(seed: int) -> int:
     config = bench_config(spec.feature_dim, spec.num_classes)
     model = model_registry.build_model(
         setup_lib.local_model_config(config.model))
-    params = model_registry.init_params(
+    return model_registry.init_params(
         model, jnp.asarray(population.example_batch(config.fed.batch_size)),
         prng.init_key(prng.experiment_key(config.run.seed)))
-    return int(sum(np.asarray(p).size for p in jax.tree.leaves(params)))
+
+
+def bench_param_count(seed: int) -> int:
+    import jax
+    import numpy as np
+
+    return int(sum(np.asarray(p).size
+                   for p in jax.tree.leaves(bench_params(seed))))
+
+
+def uplink_point(devices: int, scheme: str, topk_fraction: float,
+                 params) -> dict:
+    """One uplink wire-cost row: per-device train-reply frame bytes under
+    ``scheme`` vs the dense frame, scaled to ``devices`` reporting
+    clients.  Pure shape math (frame lengths depend on leaf
+    shapes/dtypes, not values) — identical pricing to
+    fleetsim/sim.py's ``up_frame_bytes`` / ``up_saved_bytes``."""
+    import jax
+    import numpy as np
+
+    from colearn_federated_learning_tpu.fed import compression
+    from colearn_federated_learning_tpu.utils.serialization import (
+        wire_frame_length,
+    )
+
+    t0 = time.time()
+    zeros = jax.tree.map(
+        lambda p: np.zeros(np.shape(p), np.float32), params)
+    dense = int(wire_frame_length(
+        zeros, {"round": 0, "op": "train", "compress": "none"}))
+    if scheme == "none":
+        up = dense
+    else:
+        wire, meta = compression.compress_delta(
+            zeros, scheme, topk_fraction=topk_fraction)
+        up = int(wire_frame_length(wire, {"round": 0, "op": "train", **meta}))
+    saved = max(0, dense - up)
+    return {
+        "bench": "fleet_uplink_bytes",
+        "devices": devices,
+        "scheme": scheme,
+        "topk_fraction": float(topk_fraction),
+        "param_count": int(sum(np.asarray(p).size
+                               for p in jax.tree.leaves(params))),
+        "up_frame_bytes": up,
+        "up_dense_bytes": dense,
+        "bytes_up_est_total": devices * up,
+        "bytes_up_saved_est_total": devices * saved,
+        "uplink_reduction_x": round(dense / up, 2),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
 
 
 def mask_point(devices: int, neighbors: int, group_size: int,
@@ -275,6 +349,18 @@ def main(argv=None) -> int:
     ap.add_argument("--mask-group-size", type=int, default=1024,
                     help="group-local masking group size (0 = flat "
                          "all-cohort graph)")
+    ap.add_argument("--uplink-sweep", action="store_true",
+                    help="append fleet_uplink_bytes rows: analytic "
+                         "per-scheme uplink frame bytes at "
+                         "--uplink-devices (shape-only wire pricing, "
+                         "no fleet materialized)")
+    ap.add_argument("--uplink-devices", type=int, default=1_000_000,
+                    help="reporting-device count for the uplink sweep")
+    ap.add_argument("--uplink-schemes", default="none,int8,topk",
+                    help="comma-separated fed.compress schemes to sweep")
+    ap.add_argument("--uplink-topk-fraction", type=float, default=0.05,
+                    help="topk density for the uplink sweep "
+                         "(FedConfig.topk_fraction default)")
     ap.add_argument("--append", action="store_true",
                     help="append rows to --out instead of rewriting it "
                          "(e.g. --cohorts '' --mask-sweep --append adds "
@@ -291,6 +377,13 @@ def main(argv=None) -> int:
         for k in (int(x) for x in args.mask_neighbors.split(",") if x):
             row = mask_point(args.mask_devices, k, args.mask_group_size,
                              param_count)
+            rows.append(row)
+            print(json.dumps(row))
+    if args.uplink_sweep:
+        params = bench_params(args.seed)
+        for scheme in (s for s in args.uplink_schemes.split(",") if s):
+            row = uplink_point(args.uplink_devices, scheme,
+                               args.uplink_topk_fraction, params)
             rows.append(row)
             print(json.dumps(row))
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Wire-plane round micro-bench: serialize-once broadcast + downlink delta.
+"""Wire-plane round micro-bench: broadcast, downlink delta, uplink fast path.
 
 Runs an in-process federation (MessageBroker + DeviceWorkers +
 FederatedCoordinator — the chaos-soak topology, minus the faults) over
@@ -9,17 +9,22 @@ the bench CNN shape and measures, per round:
   cohort size (the pre-PR path encoded the full model once per request,
   i.e. ``cohort`` times; that analytic "before" is recorded alongside);
 - ``comm.bytes_sent`` / ``comm.bytes_saved_downlink`` deltas and the
-  resulting downlink frame-vs-frame reduction with ``--compress-down``;
-- round latency and the streaming-fold overlap
-  (``phase_fold_overlap_s``).
+  resulting downlink frame-vs-frame reduction with ``--down-schemes``;
+- the UPLINK sweep (``--schemes`` × ``--feedback``): measured
+  ``comm.bytes_received`` / ``comm.bytes_saved_uplink`` /
+  ``comm.uplink_densify_avoided_total`` deltas per scheme, plus the
+  streaming-fold overlap (``phase_fold_overlap_s``) so the O(k) sparse
+  fold's per-contribution cost is visible next to the dense fold's;
+- round latency.
 
-One JSON summary line per (cohort, scheme) configuration is appended to
-``results/wire_bench.jsonl`` (PERF.md "Wire plane" reads from there).
+One JSON summary line per configuration is written to
+``results/wire_bench.jsonl`` (PERF.md "Wire plane" and the SLO sentinel
+rules in pyproject.toml read from there).
 
 Usage (CPU):
     JAX_PLATFORMS=cpu python scripts/bench_wire.py
     JAX_PLATFORMS=cpu python scripts/bench_wire.py \\
-        --cohorts 2,4 --schemes none,int8 --rounds 5
+        --cohorts 2,4 --schemes none,topk --feedback off,on --rounds 5
 """
 
 from __future__ import annotations
@@ -55,14 +60,18 @@ from colearn_federated_learning_tpu.utils.config import (  # noqa: E402
 _COUNTERS = (
     "comm.broadcast_encode_total",
     "comm.bytes_sent",
+    "comm.bytes_received",
     "comm.bytes_saved_downlink",
+    "comm.bytes_saved_uplink",
+    "comm.uplink_densify_avoided_total",
     "comm.resync_total",
     "comm.gather_bytes_avoided_total",
 )
 
 
-def bench_config(n_workers: int, scheme: str,
-                 tp_size: int = 1) -> ExperimentConfig:
+def bench_config(n_workers: int, scheme_down: str, tp_size: int = 1,
+                 scheme_up: str = "none",
+                 feedback: bool = False) -> ExperimentConfig:
     """The bench CNN shape: a width-16 conv net on mnist_tiny — big enough
     (~100 kB of float32 params) that frame encode/copy costs are visible,
     small enough to compile and train in seconds on CPU."""
@@ -72,13 +81,15 @@ def bench_config(n_workers: int, scheme: str,
         model=ModelConfig(name="cnn", num_classes=10, width=16),
         fed=FedConfig(strategy="fedavg", rounds=1, cohort_size=0,
                       local_steps=2, batch_size=16, lr=0.05, momentum=0.0,
-                      compress_down=scheme),
+                      compress=scheme_up, compress_feedback=feedback,
+                      compress_down=scheme_down),
         run=RunConfig(name="bench_wire", backend="cpu", seed=0,
                       tp_size=tp_size),
     )
 
 
-def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
+def run_bench(n_workers: int, scheme_down: str, scheme_up: str,
+              feedback: bool, tp_size: int, rounds: int,
               warmup_timeout: float, round_timeout: float) -> dict:
     from colearn_federated_learning_tpu.comm.broker import MessageBroker
     from colearn_federated_learning_tpu.comm.coordinator import (
@@ -92,7 +103,8 @@ def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
     import jax
     import numpy as np
 
-    config = bench_config(n_workers, scheme, tp_size)
+    config = bench_config(n_workers, scheme_down, tp_size,
+                          scheme_up=scheme_up, feedback=feedback)
     reg = telemetry.get_registry()
 
     broker = MessageBroker().start()
@@ -126,10 +138,13 @@ def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
         # sample prices every update the workers send back.
         from colearn_federated_learning_tpu.fed import compression
         zeros = jax.tree.map(np.zeros_like, params_np)
-        wire_up, meta_up = compression.compress_delta(zeros,
-                                                      config.fed.compress)
+        wire_up, meta_up = compression.compress_delta(
+            zeros, config.fed.compress,
+            topk_fraction=config.fed.topk_fraction)
         uplink_len = wire_frame_length(
             wire_up, {"round": 1, "op": "train", **meta_up})
+        uplink_dense_len = wire_frame_length(
+            zeros, {"round": 1, "op": "train", "compress": "none"})
 
         coord.run_round()                 # warmup: jit compile + delta base
         coord.round_timeout = round_timeout
@@ -141,7 +156,12 @@ def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
             per_round.append({
                 "encodes": int(delta["comm.broadcast_encode_total"]),
                 "bytes_sent": int(delta["comm.bytes_sent"]),
+                "bytes_received": int(delta["comm.bytes_received"]),
                 "bytes_saved": int(delta["comm.bytes_saved_downlink"]),
+                "bytes_saved_uplink": int(
+                    delta["comm.bytes_saved_uplink"]),
+                "densify_avoided": int(
+                    delta["comm.uplink_densify_avoided_total"]),
                 "resyncs": int(delta["comm.resync_total"]),
                 "gather_avoided": int(
                     delta["comm.gather_bytes_avoided_total"]),
@@ -159,7 +179,7 @@ def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
     encodes = [r["encodes"] for r in per_round]
     saved_per_send = (
         per_round[-1]["bytes_saved"] / max(1, per_round[-1]["sends"])
-        if scheme != "none" else 0.0
+        if scheme_down != "none" else 0.0
     )
     downlink_frame = full_len - saved_per_send
     return {
@@ -167,7 +187,9 @@ def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
         "model": "cnn-w16",
         "dataset": "mnist_tiny",
         "cohort": n_workers,
-        "scheme": scheme,
+        "scheme_down": scheme_down,
+        "scheme_up": scheme_up,
+        "feedback": feedback,
         "tp_size": tp_size,
         "rounds": rounds,
         # Sharded server (tp_size > 1): per-chip server-state bytes and
@@ -183,12 +205,24 @@ def run_bench(n_workers: int, scheme: str, tp_size: int, rounds: int,
         "downlink_frame_bytes": int(downlink_frame),
         "downlink_reduction_x": round(full_len / downlink_frame, 2),
         "uplink_frame_bytes": int(uplink_len),
+        "uplink_dense_bytes": int(uplink_dense_len),
+        # Shape-only frame ratio/reduction — what the SLO sentinels gate.
+        "uplink_bytes_ratio": round(uplink_len / uplink_dense_len, 4),
+        "uplink_reduction_x": round(uplink_dense_len / uplink_len, 2),
         "uplink_bytes_per_round": int(uplink_len * statistics.mean(
             r["sends"] for r in per_round)),
         "bytes_sent_per_round": int(statistics.mean(
             r["bytes_sent"] for r in per_round)),
+        # Measured coordinator-side receive bytes (train replies + enroll
+        # chatter) — the ground truth the frame math must track.
+        "bytes_received_per_round": int(statistics.mean(
+            r["bytes_received"] for r in per_round)),
         "bytes_saved_per_round": int(statistics.mean(
             r["bytes_saved"] for r in per_round)),
+        "bytes_saved_uplink_per_round": int(statistics.mean(
+            r["bytes_saved_uplink"] for r in per_round)),
+        "uplink_densify_avoided_per_round": int(min(
+            r["densify_avoided"] for r in per_round)),
         "resyncs_total": sum(r["resyncs"] for r in per_round),
         "round_time_s_mean": round(statistics.mean(
             r["round_time_s"] for r in per_round), 4),
@@ -204,7 +238,14 @@ def main(argv=None) -> int:
                     help="measured rounds per configuration (after 1 warmup)")
     ap.add_argument("--cohorts", default="2,4",
                     help="comma-separated cohort sizes")
-    ap.add_argument("--schemes", default="none,int8",
+    ap.add_argument("--schemes", default="int8,topk",
+                    help="comma-separated UPLINK compress schemes, swept "
+                         "at the largest cohort (the 'none' uplink "
+                         "baseline is the plain downlink row)")
+    ap.add_argument("--feedback", default="off,on",
+                    help="comma-separated error-feedback settings for the "
+                         "uplink sweep (off/on)")
+    ap.add_argument("--down-schemes", default="none,int8",
                     help="comma-separated compress_down schemes")
     ap.add_argument("--tp-sizes", default="1,2",
                     help="comma-separated server tp_size values; sizes > 1 "
@@ -218,29 +259,56 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     tp_sizes = [int(t) for t in args.tp_sizes.split(",") if t]
+    cohorts = [int(c) for c in args.cohorts.split(",") if c]
     rows = []
-    for n in (int(c) for c in args.cohorts.split(",") if c):
-        for scheme in (s.strip() for s in args.schemes.split(",") if s):
+
+    def bench_row(n, scheme_down, scheme_up, fb, tp):
+        t0 = time.time()
+        row = run_bench(n, scheme_down, scheme_up, fb, tp, args.rounds,
+                        args.warmup_timeout, args.round_timeout)
+        row["bench_wall_s"] = round(time.time() - t0, 1)
+        rows.append(row)
+        print(json.dumps({k: v for k, v in row.items()
+                          if k != "per_round"}))
+        if row["encodes_per_round"] != 1:
+            raise SystemExit(
+                f"FAIL: {row['encodes_per_round']} broadcast encodes per "
+                f"round at cohort {n} (want exactly 1)")
+        if tp > 1 and row["gather_bytes_avoided_per_round"] <= 0:
+            raise SystemExit(
+                f"FAIL: tp_size={tp} row avoided no gather bytes "
+                "(sharded downlink not engaged)")
+        if scheme_up == "topk":
+            if row["uplink_densify_avoided_per_round"] < n:
+                raise SystemExit(
+                    "FAIL: topk uplink row folded "
+                    f"{row['uplink_densify_avoided_per_round']} of {n} "
+                    "contributions sparse (sparse-native fold not engaged)")
+            if row["uplink_reduction_x"] < 6.0:
+                raise SystemExit(
+                    "FAIL: topk uplink reduction "
+                    f"{row['uplink_reduction_x']}x < 6x vs the dense frame")
+        return row
+
+    # Downlink matrix (unchanged axes): cohorts × down-schemes × tp.
+    for n in cohorts:
+        for scheme_down in (s.strip() for s in args.down_schemes.split(",")
+                            if s):
             # Sharded-server rows ride on the uncompressed scheme (the
             # encode path is byte-identical either way; one sweep axis at
             # a time keeps the matrix readable).
-            for tp in (tp_sizes if scheme == "none" else [1]):
-                t0 = time.time()
-                row = run_bench(n, scheme, tp, args.rounds,
-                                args.warmup_timeout, args.round_timeout)
-                row["bench_wall_s"] = round(time.time() - t0, 1)
-                rows.append(row)
-                print(json.dumps({k: v for k, v in row.items()
-                                  if k != "per_round"}))
-                if row["encodes_per_round"] != 1:
-                    print(f"FAIL: {row['encodes_per_round']} broadcast "
-                          f"encodes per round at cohort {n} (want exactly "
-                          "1)", file=sys.stderr)
-                    return 1
-                if tp > 1 and row["gather_bytes_avoided_per_round"] <= 0:
-                    print(f"FAIL: tp_size={tp} row avoided no gather bytes "
-                          "(sharded downlink not engaged)", file=sys.stderr)
-                    return 1
+            for tp in (tp_sizes if scheme_down == "none" else [1]):
+                bench_row(n, scheme_down, "none", False, tp)
+
+    # Uplink sweep at the largest cohort: scheme × feedback.  Feedback on
+    # a lossless uplink is a no-op, so "none" only appears as the
+    # baseline rows above.
+    n_up = max(cohorts)
+    for scheme_up in (s.strip() for s in args.schemes.split(",") if s):
+        if scheme_up == "none":
+            continue
+        for fb_s in (s.strip() for s in args.feedback.split(",") if s):
+            bench_row(n_up, "none", scheme_up, fb_s == "on", 1)
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:

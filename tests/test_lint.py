@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL012), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL013), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -677,6 +677,67 @@ def test_cl012_suppression(tmp_path):
             host = jax.tree.map(np.asarray, delta)  # colearn: noqa(CL012)
             return scale(host, w)
     """, relpath="pkg/comm/aggregation.py")
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl013_flags_decompress_in_hot_aggregation_path(tmp_path):
+    res = run_lint(tmp_path, """
+        from pkg.fed import compression
+
+        def add(self, meta, delta):  # colearn: hot
+            dense = compression.decompress_delta(delta, meta,
+                                                 shapes=self.shapes)
+            return self.stage(dense)
+    """, relpath="pkg/comm/aggregation.py", rules=["CL013"])
+    assert rule_ids(res) == ["CL013"]
+    assert res.exit_code == 1
+
+
+def test_cl013_flags_full_shape_alloc_in_hot_loop(tmp_path):
+    res = run_lint(tmp_path, """
+        import numpy as np
+
+        def fold(folder, updates):
+            for meta, idx, vals in updates:  # colearn: hot
+                buf = np.zeros(folder.model_shape, np.float32)
+                buf.reshape(-1)[idx] = vals
+                folder.accumulate(buf)
+    """, relpath="pkg/comm/aggregation.py", rules=["CL013"])
+    assert rule_ids(res) == ["CL013"]
+
+
+def test_cl013_allows_cold_paths_and_other_dirs(tmp_path):
+    # The once-per-round accumulator densify at finalize is NOT hot.
+    res = run_lint(tmp_path, """
+        import numpy as np
+
+        def finalize(self, staged):
+            acc = np.zeros(self.model_shape, np.float32)
+            for idx, vals in staged:
+                acc.reshape(-1)[idx] += vals
+            return acc
+    """, relpath="pkg/comm/aggregation.py", rules=["CL013"])
+    assert res.findings == []
+    # Hot full-shape alloc OUTSIDE comm/: not CL013's business.
+    res = run_lint(tmp_path, """
+        import numpy as np
+
+        def estimate(shape):  # colearn: hot
+            return np.zeros(shape, np.float32)
+    """, relpath="pkg/fleetsim/mod.py", rules=["CL013"])
+    assert res.findings == []
+
+
+def test_cl013_suppression(tmp_path):
+    # int8 dequantize is inherently dense — the sanctioned noqa shape.
+    res = run_lint(tmp_path, """
+        from pkg.fed import compression
+
+        def add(self, meta, delta):  # colearn: hot
+            dense = compression.decompress_delta(  # colearn: noqa(CL013)
+                delta, meta, shapes=self.shapes)
+            return self.stage(dense)
+    """, relpath="pkg/comm/aggregation.py", rules=["CL013"])
     assert res.findings == [] and res.suppressed == 1
 
 

@@ -1,0 +1,396 @@
+"""PR 10 uplink fast path: sparse-native streaming fold + client-side
+error feedback.
+
+- StreamingFolder folds topk contributions from their wire (indices,
+  values) — O(k) staged bytes, no per-update densify — BITWISE identical
+  to the densify-then-sum fold it replaces: full cohort, partial cohort,
+  the secure-agg correction hook, and the tp=2 sharded server
+  (per-shard offset-adjusted indices via
+  ServerPlacement.partition_flat_indices).
+- feedback_compress carries the compression residual across rounds
+  (EF-SGD): the residual is exactly what the codec dropped, and feeding
+  it back de-biases repeated sparsification.
+- DeviceWorker engages feedback only when it is sound (lossy codec, no
+  secure_agg), resets the residual on a param-cache miss, and exports
+  ``fed.uplink_residual_norm``.
+- Config validation rejects the unsound combinations up front.
+- Convergence: a topk+feedback federation tracks the dense-uplink
+  baseline.
+"""
+
+import dataclasses
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.comm import downlink
+from colearn_federated_learning_tpu.comm.aggregation import (
+    StreamingFolder,
+    _SparseStage,
+)
+from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+from colearn_federated_learning_tpu.fed import compression
+from colearn_federated_learning_tpu.fed import setup as setup_lib
+from colearn_federated_learning_tpu.parallel import partition
+from colearn_federated_learning_tpu.utils.config import validate_robustness
+
+from tests.test_comm import _config, _run_federation
+
+
+def _params():
+    rng = np.random.default_rng(7)
+    f = lambda *s: rng.standard_normal(s).astype(np.float32)
+    return {
+        "params": {
+            "Embed_0": {"embedding": f(16, 8)},
+            "TransformerBlock_0": {
+                "attn": {"query": {"kernel": f(8, 4, 2), "bias": f(4, 2)},
+                         "out": {"kernel": f(4, 2, 8)}},
+                "Dense_0": {"kernel": f(8, 32), "bias": f(32)},
+                "Dense_1": {"kernel": f(32, 8)},
+                "LayerNorm_0": {"scale": f(8)},
+            },
+        }
+    }
+
+
+@pytest.fixture(scope="module")
+def placement():
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("needs the forced 8-device CPU host")
+    pl = partition.make_server_placement(
+        _params(), 4, "model", "bert", devices=devs[:4])
+    assert pl is not None
+    return pl
+
+
+def _tree_bytes(tree):
+    return [np.asarray(l).tobytes() for l in jax.tree.leaves(tree)]
+
+
+def _topk_updates(n, fraction=0.1):
+    """n (meta, wire) topk contributions plus the exact dense trees the
+    dense reference fold would stage (decompress of the same wire)."""
+    shapes = _params()
+    out = []
+    for i in range(n):
+        rng = np.random.default_rng(100 + i)
+        d = jax.tree.map(
+            lambda w: rng.standard_normal(w.shape).astype(np.float32),
+            shapes)
+        wire, cmeta = compression.compress_delta(d, "topk",
+                                                 topk_fraction=fraction)
+        meta = {"client_id": str(i), "weight": 1.0 + 0.25 * i,
+                "mean_loss": 0.5 + 0.1 * i, **cmeta}
+        dense = compression.decompress_delta(wire, cmeta, shapes=shapes)
+        out.append((meta, wire, dense))
+    return out
+
+
+# --------------------------------------------------- sparse fold parity ----
+@pytest.mark.parametrize("present", [5, 3])  # full cohort / partial cohort
+def test_sparse_fold_bitwise_parity(present):
+    shapes = _params()
+    order = [str(i) for i in range(5)]
+    updates = _topk_updates(5)[:present]
+    arrival = list(updates)
+    random.Random(13).shuffle(arrival)     # fold must not care
+
+    sparse = StreamingFolder(shapes, order=order)
+    dense = StreamingFolder(shapes, order=order)
+    for meta, wire, d in arrival:
+        sparse.add(dict(meta), jax.tree.map(np.copy, wire))
+        # Reference: the exact densify-then-sum path (no compress key →
+        # the staged tree is the decompressed delta).
+        ref_meta = {k: v for k, v in meta.items() if k != "compress"}
+        dense.add(ref_meta, jax.tree.map(np.copy, d))
+
+    m_sp, w_sp, l_sp = sparse.mean()
+    m_dn, w_dn, l_dn = dense.mean()
+    assert w_sp == w_dn and l_sp == l_dn
+    assert _tree_bytes(m_sp) == _tree_bytes(m_dn)
+    assert sparse.densify_avoided == present
+    assert dense.densify_avoided == 0
+
+
+def test_sparse_fold_correction_bitwise_parity():
+    shapes = _params()
+    order = [str(i) for i in range(4)]
+    corr = jax.tree.map(
+        lambda w: np.full(w.shape, 0.125, np.float32), shapes)
+
+    sparse = StreamingFolder(shapes, order=order)
+    dense = StreamingFolder(shapes, order=order)
+    for meta, wire, d in _topk_updates(4):
+        sparse.add(dict(meta), wire)
+        dense.add({k: v for k, v in meta.items() if k != "compress"}, d)
+    sparse.finalize(); dense.finalize()
+    sparse.apply_correction(corr)
+    dense.apply_correction(corr)
+    m_sp, _, _ = sparse.mean()
+    m_dn, _, _ = dense.mean()
+    assert _tree_bytes(m_sp) == _tree_bytes(m_dn)
+
+
+def test_sparse_fold_sharded_bitwise_parity(placement):
+    """tp=2+ sharded sparse fold == replicated sparse fold == dense fold,
+    all bitwise (per-shard host reads vs the replicated leaves)."""
+    shapes = placement.shapes_tree()
+    order = [str(i) for i in range(4)]
+    updates = _topk_updates(4)
+    arrival = list(updates)
+    random.Random(13).shuffle(arrival)
+
+    rep = StreamingFolder(shapes, order=order)
+    shd = StreamingFolder(shapes, order=order, placement=placement)
+    dns = StreamingFolder(shapes, order=order)
+    for meta, wire, d in arrival:
+        rep.add(dict(meta), jax.tree.map(np.copy, wire))
+        shd.add(dict(meta), jax.tree.map(np.copy, wire))
+        dns.add({k: v for k, v in meta.items() if k != "compress"},
+                jax.tree.map(np.copy, d))
+
+    m_rep, w_rep, _ = rep.mean()
+    m_shd, w_shd, _ = shd.mean()
+    m_dns, _, _ = dns.mean()
+    assert w_rep == w_shd
+    assert shd.densify_avoided == 4
+    host = partition.host_tree(m_shd)
+    assert _tree_bytes(m_rep) == _tree_bytes(host)
+    assert _tree_bytes(m_dns) == _tree_bytes(host)
+    for leaf in jax.tree.leaves(m_shd):
+        assert isinstance(leaf, jax.Array)
+
+
+def test_partition_flat_indices_roundtrip(placement):
+    """Scattering per-shard (local indices on the shard shape) rebuilds
+    exactly the full-leaf scatter, for every leaf of the placement."""
+    shapes = placement.shapes_tree()
+    refs = jax.tree.leaves(shapes)
+    rng = np.random.default_rng(3)
+    for pos, ref in enumerate(refs):
+        size = int(np.prod(np.shape(ref), dtype=np.int64)) or 1
+        k = max(1, size // 7)
+        idx = rng.choice(size, size=k, replace=False).astype(np.int64)
+        vals = rng.standard_normal(k).astype(np.float32)
+        full = np.zeros(size, np.float32)
+        full[idx] = vals
+        full = full.reshape(np.shape(ref))
+
+        shards = placement.partition_flat_indices(pos, idx, vals)
+        # Densify each shard locally, then reassemble via slice order.
+        slices = placement._meta[pos][3]
+        rebuilt = np.zeros(np.shape(ref), np.float32)
+        if len(shards) == 1 and len(slices) == 1:
+            flat = np.zeros(size, np.float32)
+            flat[shards[0][0]] = shards[0][1]
+            rebuilt = flat.reshape(np.shape(ref))
+        else:
+            total = 0
+            for (lidx, lvals, sshape), (_, index) in zip(shards, slices):
+                local = np.zeros(
+                    int(np.prod(sshape, dtype=np.int64)), np.float32)
+                local[lidx] = lvals
+                rebuilt[tuple(index)] = local.reshape(sshape)
+                total += len(lidx)
+            assert total == k          # every entry lands in exactly 1 shard
+        np.testing.assert_array_equal(rebuilt, full)
+
+
+def test_sparse_staging_is_o_k_and_counted():
+    """Staged topk contributions hold (indices, values) only — k entries
+    per leaf, never a full-shape tensor — and each sparse fold advances
+    comm.uplink_densify_avoided_total."""
+    shapes = _params()
+    frac = 0.05
+    ctr = telemetry.get_registry().counter(
+        "comm.uplink_densify_avoided_total")
+    before = ctr.value
+    folder = StreamingFolder(shapes, order=["0", "1"])
+    for meta, wire, _ in _topk_updates(2, fraction=frac):
+        folder.add(dict(meta), wire)
+    assert ctr.value - before == 2
+    assert folder.densify_avoided == 2
+    for _, contrib, _ in folder._staged.values():
+        assert isinstance(contrib, _SparseStage)
+        for triples, ref in zip(contrib.leaves, jax.tree.leaves(shapes)):
+            k_max = max(1, int(np.ceil(ref.size * frac)))
+            staged = sum(len(idx) for idx, _, _ in triples)
+            assert staged <= k_max < ref.size
+
+
+# ----------------------------------------------------- error feedback ------
+def test_feedback_compress_residual_roundtrip():
+    shapes = _params()
+    rng = np.random.default_rng(0)
+    d1 = jax.tree.map(
+        lambda w: rng.standard_normal(w.shape).astype(np.float32), shapes)
+    d2 = jax.tree.map(
+        lambda w: rng.standard_normal(w.shape).astype(np.float32), shapes)
+
+    wire, meta, res = compression.feedback_compress(d1, None, "topk")
+    recon = compression.decompress_delta(wire, meta, shapes=shapes)
+    for r, a, b in zip(jax.tree.leaves(res), jax.tree.leaves(d1),
+                       jax.tree.leaves(recon)):
+        np.testing.assert_array_equal(r, a - b)
+
+    # Second round: the compensated delta (d2 + residual) is what gets
+    # compressed, and the new residual is exactly what its codec dropped.
+    wire2, meta2, res2 = compression.feedback_compress(d2, res, "topk")
+    comp = jax.tree.map(np.add, d2, res)
+    recon2 = compression.decompress_delta(wire2, meta2, shapes=shapes)
+    for r, a, b in zip(jax.tree.leaves(res2), jax.tree.leaves(comp),
+                       jax.tree.leaves(recon2)):
+        np.testing.assert_array_equal(r, a - b)
+
+    # Lossless scheme: nothing dropped, nothing carried.
+    wire3, _, res3 = compression.feedback_compress(d2, res2, "none")
+    assert res3 is None
+    # ... but the pending residual still ships with the dense frame.
+    for a, b, r in zip(jax.tree.leaves(wire3), jax.tree.leaves(d2),
+                       jax.tree.leaves(res2)):
+        np.testing.assert_array_equal(a, b + r)
+
+
+def test_feedback_debiases_repeated_topk():
+    """EF-SGD property: over T rounds of the SAME delta, the summed
+    reconstructions with feedback approach T x delta (small entries
+    eventually ship via the accumulated residual); without feedback they
+    are dropped forever."""
+    rng = np.random.default_rng(5)
+    d = {"w": rng.standard_normal((64,)).astype(np.float32)}
+    T = 24
+    acc_fb = np.zeros(64, np.float32)
+    acc_raw = np.zeros(64, np.float32)
+    res = None
+    for _ in range(T):
+        wire, meta, res = compression.feedback_compress(
+            d, res, "topk", topk_fraction=0.1)
+        acc_fb += compression.decompress_delta(wire, meta, shapes=d)["w"]
+        wire, meta = compression.compress_delta(d, "topk", topk_fraction=0.1)
+        acc_raw += compression.decompress_delta(wire, meta, shapes=d)["w"]
+    target = T * d["w"]
+    err_fb = np.linalg.norm(acc_fb - target)
+    err_raw = np.linalg.norm(acc_raw - target)
+    assert err_fb < 0.5 * err_raw
+
+
+def test_topk_fraction_override_controls_density():
+    d = {"w": np.arange(1, 101, dtype=np.float32)}
+    for frac, k in ((0.5, 50), (0.02, 2)):
+        wire, meta = compression.compress_delta(d, "topk",
+                                                topk_fraction=frac)
+        idx, vals, size = compression.topk_leaf_arrays(wire["w"])
+        assert size == 100 and len(idx) == len(vals) == k
+        out = compression.decompress_delta(wire, meta, shapes=d)
+        assert np.count_nonzero(out["w"]) == k
+
+
+# ------------------------------------------------------- worker plane ------
+def _worker_cfg(**fed_kw):
+    base = dict(compress="topk", compress_feedback=True, rounds=1,
+                local_steps=2, momentum=0.0)
+    base.update(fed_kw)
+    return _config(num_clients=2, **base)
+
+
+def test_worker_feedback_residual_and_resync_reset():
+    cfg = _worker_cfg()
+    w = DeviceWorker(cfg, 0)
+    try:
+        assert w._uplink_residual is None
+        params = setup_lib.init_global_params(cfg)
+        header, wire = w._train(0, jax.tree.map(np.asarray, params))
+        assert header["meta"]["compress"] == "topk"
+        assert w._uplink_residual is not None
+        norm = telemetry.get_registry().gauge("fed.uplink_residual_norm")
+        assert np.isfinite(norm.value) and norm.value > 0.0
+
+        # A delta broadcast this worker has no cached base for must
+        # answer "resync" AND drop the residual: it belongs to an update
+        # the server never folded against that base.
+        header, _ = w._train(
+            1, None, meta={downlink.DOWN_KEY: downlink.MODE_DELTA,
+                           downlink.DOWN_BASE_KEY: 0})
+        assert header["status"] == "resync"
+        assert w._uplink_residual is None
+    finally:
+        w.stop()
+
+
+def test_worker_without_feedback_keeps_no_residual():
+    cfg = _worker_cfg(compress_feedback=False)
+    w = DeviceWorker(cfg, 0)
+    try:
+        params = setup_lib.init_global_params(cfg)
+        header, _ = w._train(0, jax.tree.map(np.asarray, params))
+        assert header["meta"]["compress"] == "topk"
+        assert w._uplink_residual is None
+    finally:
+        w.stop()
+
+
+# --------------------------------------------------------- validation ------
+def test_worker_rejects_secure_agg_with_feedback():
+    cfg = _worker_cfg(compress="none", secure_agg=True,
+                      secure_agg_key_exchange="shared_seed")
+    with pytest.raises(ValueError, match="error feedback"):
+        DeviceWorker(cfg, 0)
+
+
+def test_validate_robustness_rejects_unsound_uplink_configs():
+    base = _config(num_clients=2)
+    bad = [
+        (dict(compress="gzip9"), "unknown compress"),
+        (dict(topk_fraction=0.0), "topk_fraction"),
+        (dict(topk_fraction=1.5), "topk_fraction"),
+        (dict(secure_agg=True, secure_agg_key_exchange="shared_seed",
+              compress_feedback=True), "error feedback"),
+    ]
+    for kw, match in bad:
+        cfg = base.replace(fed=dataclasses.replace(base.fed, **kw))
+        with pytest.raises(ValueError, match=match):
+            validate_robustness(cfg)
+    ok = base.replace(fed=dataclasses.replace(
+        base.fed, compress="topk", compress_feedback=True,
+        topk_fraction=0.25))
+    validate_robustness(ok)           # sound combination passes
+
+
+# -------------------------------------------------------- convergence ------
+def test_topk_feedback_federation_tracks_dense_baseline():
+    """Convergence pin: a topk+feedback federation's loss trajectory and
+    final params stay close to the dense-uplink run — error feedback
+    bounds the sparsification drift the same way the downlink
+    reconstruction base bounds quantization drift."""
+    reg = telemetry.get_registry()
+    cfg = _config(num_clients=3, momentum=0.0, lr=0.05)
+    base_recs, base_losses, base_params = _run_federation(cfg, 3, rounds=4)
+
+    cfg_up = cfg.replace(fed=dataclasses.replace(
+        cfg.fed, compress="topk", compress_feedback=True,
+        topk_fraction=0.25))
+    saved = reg.counter("comm.bytes_saved_uplink")
+    avoided = reg.counter("comm.uplink_densify_avoided_total")
+    saved0, avoided0 = saved.value, avoided.value
+    up_recs, up_losses, up_params = _run_federation(cfg_up, 3, rounds=4)
+
+    assert all(r["completed"] == 3 for r in base_recs + up_recs)
+    # Every round folds 3 sparse contributions and prices the savings.
+    assert avoided.value - avoided0 == 12
+    assert saved.value - saved0 > 0
+    for r in up_recs:
+        assert r["uplink_densify_avoided"] == 3
+        assert r["bytes_saved_uplink"] > 0
+    for r in base_recs:
+        assert "uplink_densify_avoided" not in r
+        assert "bytes_saved_uplink" not in r
+    # Sparsified rounds drift slightly; trajectories must stay close.
+    np.testing.assert_allclose(up_losses, base_losses, rtol=0.2, atol=0.1)
+    for a, b in zip(jax.tree.leaves(base_params),
+                    jax.tree.leaves(up_params)):
+        np.testing.assert_allclose(a, b, atol=0.08)
