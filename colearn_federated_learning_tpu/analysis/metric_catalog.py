@@ -53,6 +53,15 @@ COUNTERS = (
     # key exchange & broker healing (comm/keyexchange.py, comm/coordinator.py)
     "comm.keyexchange_rejected_total",  # labeled {reason=zero|identity|...}
     "comm.broker_reconnects_total",     # labeled {outcome=ok|failed}
+    # aggregator tree (comm/aggregator.py, comm/coordinator.py)
+    "comm.agg_folds_total",             # labeled {agg=<id>}: partials folded
+    "comm.agg_failovers_total",         # labeled {action=rehome|drop}
+    "comm.agg_heartbeat_expired_total",  # stale heartbeat seen at dispatch
+    # durable enrollment + challenge-on-resume (ckpt/wal.py EnrollmentLedger,
+    # comm/coordinator.py verify_resumed_devices)
+    "comm.enroll_ledger_appends_total",
+    "comm.enroll_challenge_rejected_total",  # labeled {reason=not_in_ledger|
+    #                                          bad_tag|unreachable|...}
     # dropout-tolerant secure aggregation (privacy/dropout.py,
     # comm/coordinator.py share phase + mask recovery)
     "privacy.shares_distributed_total",     # encrypted share blobs relayed
@@ -75,6 +84,7 @@ COUNTERS = (
     "fed.mesh_fallback_total",
     # file & hierarchical planes (fed/offline.py, fed/hierarchical.py)
     "fed.offline_updates_rejected_total",  # labeled {reason=torn|stale|...}
+    "fed.offline_residual_resets_total",   # labeled {reason=stale|...}
     "fed.hier_groups_dropped_total",       # labeled per group: {group=g1}
     # buffered-async plane (comm/async_coordinator.py)
     "async.dispatch_failures",
@@ -109,6 +119,9 @@ GAUGES = (
     # uplink error feedback (comm/worker.py): norm of the carried
     # compression residual — should stay bounded round over round
     "fed.uplink_residual_norm",
+    # adaptive topk (comm/worker.py _adapt_topk): the per-round density
+    # the controller actually used, inside [topk_min, topk_max]
+    "fed.topk_fraction_effective",
     # live HBM sampling (telemetry/runtime.py; empty on CPU backends)
     "runtime.hbm_bytes_in_use",
     "runtime.hbm_bytes_limit",

@@ -1,4 +1,4 @@
 from colearn_federated_learning_tpu.ckpt.manager import RoundCheckpointer
-from colearn_federated_learning_tpu.ckpt.wal import RoundWal
+from colearn_federated_learning_tpu.ckpt.wal import EnrollmentLedger, RoundWal
 
-__all__ = ["RoundCheckpointer", "RoundWal"]
+__all__ = ["RoundCheckpointer", "RoundWal", "EnrollmentLedger"]

@@ -95,3 +95,71 @@ class RoundWal:
         if self._f is not None:
             self._f.close()
             self._f = None
+
+
+class EnrollmentLedger(RoundWal):
+    """Durable admission record: WHO the coordinator ever admitted, and
+    under WHICH identity public key.
+
+    Broker-retained announcements are soft state — they die with the
+    broker, replay after it restarts, and anyone who can publish can
+    forge one.  This ledger is the hard state a resumed coordinator
+    trusts instead: one fsynced JSON line per admission (device_id,
+    address, identity pubkey, wall time), latest line per device wins.
+    ``coordinator.verify_resumed_devices`` readmits a device only when
+    it is in this ledger AND answers a nonce challenge under the
+    recorded key.  Reuses the RoundWal machinery wholesale — append-only
+    JSONL, fsync per append, torn final line tolerated on load.
+    """
+
+    FILENAME = "enroll_ledger.jsonl"
+
+    def append(self, entry: dict) -> None:
+        f = self._handle()
+        f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+        _metrics.get_registry().counter(
+            "comm.enroll_ledger_appends_total").inc()
+
+    def admit(self, dev) -> None:
+        """Record one admission (DeviceInfo or any object with
+        device_id/host/port/pubkey attributes)."""
+        import time
+
+        self.append({
+            "device_id": str(dev.device_id),
+            "host": str(dev.host),
+            "port": int(dev.port),
+            "pubkey": str(getattr(dev, "pubkey", "") or ""),
+            "ts": time.time(),
+        })
+
+    def revoke(self, device_id: str) -> None:
+        """Durably retract a device's admission — the challenge-on-resume
+        reject path.  Latest-line-wins turns the retraction into absence
+        from :meth:`devices`, so an admission appended from a replayed or
+        forged announcement (the resumed enrollment records devices
+        before the challenge can vet them) cannot satisfy a LATER resume
+        either.  A genuine re-admission after the revocation supersedes
+        it — revocation is an append, not a ban."""
+        import time
+
+        self.append({"device_id": str(device_id), "revoked": True,
+                     "ts": time.time()})
+
+    def devices(self) -> dict:
+        """``device_id -> latest admission record``.  Re-announcing with
+        a fresh key supersedes the old binding (last line wins), so key
+        rotation is an append, not an edit; a revocation line erases the
+        device until its next admission."""
+        out: dict[str, dict] = {}
+        for entry in self.load():
+            did = str(entry.get("device_id", ""))
+            if not did:
+                continue
+            if entry.get("revoked"):
+                out.pop(did, None)
+            else:
+                out[did] = entry
+        return out

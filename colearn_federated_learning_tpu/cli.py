@@ -87,6 +87,27 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--compress", default=None,
                    choices=["none", "int8", "topk"],
                    help="update compression on the wire/file planes")
+    p.add_argument("--compress-feedback", action="store_true", default=None,
+                   help="carry the uplink compression residual into the "
+                        "next round's delta (error feedback; rejected "
+                        "under secure_agg)")
+    p.add_argument("--topk-fraction", type=float, default=None,
+                   help="topk keep density (fraction of entries per leaf)")
+    p.add_argument("--topk-adaptive", action="store_true", default=None,
+                   help="steer each worker's topk density off its "
+                        "error-feedback residual norm, clipped to "
+                        "[--topk-min-fraction, --topk-max-fraction] "
+                        "(needs --compress topk + feedback)")
+    p.add_argument("--topk-min-fraction", type=float, default=None)
+    p.add_argument("--topk-max-fraction", type=float, default=None)
+    p.add_argument("--num-aggregators", type=int, default=None,
+                   help="aggregator-tree fan-in: N `colearn aggregator` "
+                        "processes each fold one cohort slice and ship "
+                        "one partial sum to the coordinator "
+                        "(comm/aggregator.py; 0 = flat)")
+    p.add_argument("--agg-heartbeat-timeout", type=float, default=None,
+                   help="treat an aggregator as dead when its retained "
+                        "heartbeat is older than this many seconds")
     p.add_argument("--compress-down", default=None,
                    choices=["none", "int8", "topk"],
                    help="DOWNLINK broadcast compression (synchronous "
@@ -218,15 +239,18 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "dp_adaptive_clip", "dp_target_quantile", "dp_clip_lr",
              "dp_bit_noise", "secure_agg", "secure_agg_neighbors",
              "straggler_prob", "compress", "compress_down", "aggregator",
+             "compress_feedback", "topk_fraction", "topk_adaptive",
+             "topk_min_fraction", "topk_max_fraction",
              "trim_fraction", "edge_groups", "edge_sync_period",
              "min_cohort_fraction"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
 _MODEL_KEYS = {"attn_impl", "remat", "stem", "norm", "width"}
 _RUN_KEYS = {"backend", "seed", "tp_size", "eval_every", "log_every",
+             "checkpoint_dir",
              "checkpoint_every", "profile_dir", "trace_dir", "trace_rounds",
              "evict_after", "worker_enroll_timeout", "comm_retries",
              "comm_backoff_base", "comm_backoff_max", "fault_plan",
-             "fault_seed"}
+             "fault_seed", "num_aggregators", "agg_heartbeat_timeout"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
@@ -272,7 +296,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                   "--out", file=sys.stderr)
             return 2
         stats = offline.client_update(config, args.client_id,
-                                      args.global_model, args.out)
+                                      args.global_model, args.out,
+                                      residual_path=args.residual_path)
         print(json.dumps(stats))
         return 0
 
@@ -439,6 +464,22 @@ def cmd_worker(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_aggregator(args: argparse.Namespace) -> int:
+    from colearn_federated_learning_tpu.comm.aggregator import (
+        run_aggregator_forever,
+    )
+
+    config = config_from_args(args)
+    if args.agg_id is None:
+        print("aggregator requires --agg-id", file=sys.stderr)
+        return 2
+    _install_fault_plan(config)
+    _setup_observability(args, role=f"aggregator{args.agg_id}")
+    run_aggregator_forever(config, args.agg_id, args.broker_host,
+                           args.broker_port, heartbeat_s=args.heartbeat)
+    return 0
+
+
 def _write_coordinator_trace(config, coord) -> None:
     """Flush the coordinator's span buffer (round phases + adopted worker
     spans) to a Chrome-trace JSON when --trace-dir is set."""
@@ -567,6 +608,17 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             _coordinator_resume(coord)
         coord.enroll(min_devices=args.min_devices,
                      timeout=args.enroll_timeout)
+        if args.resume:
+            # Challenge-on-resume: retained announcements alone readmit
+            # nobody — only ledger-known devices that answer the nonce
+            # challenge keep their seat (comm/coordinator.py).
+            verdict = coord.verify_resumed_devices()
+            print(json.dumps({"event": "challenge_verified", **verdict}),
+                  file=sys.stderr)
+        if coord.num_aggregators:
+            aggs = coord.enroll_aggregators(timeout=args.enroll_timeout)
+            print(json.dumps({"event": "aggregators_enrolled",
+                              "aggregators": aggs}), file=sys.stderr)
         hist = coord.fit(log_fn=lambda rec: (print(json.dumps(rec),
                                                    file=sys.stderr),
                                              obs(rec))[0],
@@ -591,6 +643,33 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print("--secure is an in-process exactness gate; drop --mp",
               file=sys.stderr)
         return 2
+    if args.agg and (args.secure or args.mp):
+        print("--agg is its own multi-process gate; drop --secure/--mp",
+              file=sys.stderr)
+        return 2
+    if args.agg:
+        from colearn_federated_learning_tpu.faults import procsoak
+
+        summary = procsoak.run_agg_soak(
+            rounds=args.rounds, n_workers=args.num_workers,
+            workdir=args.workdir, round_timeout=args.mp_round_timeout,
+            timeout_s=args.mp_timeout, kill=not args.no_faults,
+            log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+        )
+        print(json.dumps(summary))
+        ok = (summary["exit_code"] == 0
+              and summary["oracle_exit_code"] == 0
+              and summary["rounds_run"] == args.rounds
+              and summary["oracle_ok"]
+              # A gate that never exercised failover proves nothing:
+              # with the kill armed, the tree must have re-homed or
+              # quorum-dropped at least one slice, the postmortem must
+              # attribute the kill, and the flight dump must exist.
+              and (args.no_faults
+                   or (summary["agg_failovers"] >= 1
+                       and summary["postmortem_attributed"]
+                       and not summary["flight_missing"])))
+        return 0 if ok else 1
     if args.mp:
         from colearn_federated_learning_tpu.faults import procsoak
 
@@ -934,6 +1013,10 @@ def main(argv: list[str] | None = None) -> int:
                          help="global model npz (client role)")
     p_train.add_argument("--out", default=None,
                          help="update npz to write (client role)")
+    p_train.add_argument("--residual-path", default=None,
+                         help="client role: persist the uplink error-"
+                              "feedback compression residual here across "
+                              "file-plane rounds (--compress-feedback)")
     p_train.add_argument("--resume", action="store_true")
     p_train.add_argument("--per-client-eval", action="store_true",
                          help="report per-client accuracy spread at the end")
@@ -989,6 +1072,21 @@ def main(argv: list[str] | None = None) -> int:
                                "announced on enrollment (comm/mud.py)")
     _add_observability_flags(p_worker)
     p_worker.set_defaults(fn=cmd_worker)
+
+    p_aggtier = sub.add_parser(
+        "aggregator",
+        help="run one aggregator-tree process: folds its cohort slice "
+             "and ships one partial sum to the coordinator "
+             "(comm/aggregator.py)")
+    _add_override_flags(p_aggtier)
+    p_aggtier.add_argument("--agg-id", type=int, default=None)
+    p_aggtier.add_argument("--broker-host", default="127.0.0.1")
+    p_aggtier.add_argument("--broker-port", type=int, required=True)
+    p_aggtier.add_argument("--heartbeat", type=float, default=0.5,
+                           help="retained-announce heartbeat period (s); "
+                                "the coordinator's liveness signal")
+    _add_observability_flags(p_aggtier)
+    p_aggtier.set_defaults(fn=cmd_aggregator)
 
     p_coord = sub.add_parser("coordinate",
                              help="run the federated coordinator over "
@@ -1060,6 +1158,12 @@ def main(argv: list[str] | None = None) -> int:
                               "workers as real subprocesses, real SIGKILL "
                               "on the canned schedule (coordinator "
                               "included — exercises --resume recovery)")
+    p_chaos.add_argument("--agg", action="store_true",
+                         help="aggregator-tree failover gate: a real "
+                              "2-aggregator federation with one "
+                              "aggregator SIGKILLed mid-round, final "
+                              "params lockstep vs a flat oracle run "
+                              "(faults/procsoak.run_agg_soak)")
     p_chaos.add_argument("--workdir", default=None,
                          help="--mp scratch dir for checkpoints + process "
                               "logs (default: a fresh temp dir)")

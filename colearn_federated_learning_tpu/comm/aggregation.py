@@ -110,14 +110,34 @@ class StreamingFolder(UpdateFolder):
     stays bitwise equal to the replicated one.  ``densify_avoided``
     counts contributions folded sparse (mirrored to the
     ``comm.uplink_densify_avoided_total`` counter).
+
+    ``slices`` (the aggregator-tree reference layout) partitions the
+    cohort order into contiguous blocks: :meth:`finalize` folds each
+    block sequentially into its own partial (weighted sum, total weight
+    AND weighted loss all accumulate block-locally from zero), then
+    combines the block partials sequentially in block order.  That is
+    float addition REGROUPED at the block boundaries — exactly the sum
+    the distributed aggregator tier computes when each aggregator folds
+    its slice and the root folds the N partials — so a flat folder built
+    with the tree's slice layout is the BITWISE oracle for the tree fold
+    (parity tests pin it, dense and topk, replicated and sharded).
+    ``slices=None`` (every existing call site) keeps the single-pass
+    fold byte-identical to before; a single all-cohort slice is also
+    bitwise identical to ``None`` (``0.0 + x == x`` for the positive
+    weights and the first block's partial is adopted, never re-added).
+    Staged ids not covered by any slice (stragglers admitted past the
+    layout) fold as one trailing block.
     """
 
     def __init__(self, shapes: Any, order: Optional[Sequence[str]] = None,
-                 placement: Optional[Any] = None):
+                 placement: Optional[Any] = None,
+                 slices: Optional[Sequence[Sequence[str]]] = None):
         super().__init__(shapes)
         self._order = list(order) if order is not None else None
         self._staged: dict[str, tuple[float, Any, float]] = {}
         self._placement = placement
+        self._slices = ([list(s) for s in slices]
+                        if slices is not None else None)
         self.fold_s = 0.0
         self.folded_ids: list[str] = []
         self.densify_avoided = 0
@@ -184,7 +204,31 @@ class StreamingFolder(UpdateFolder):
                 leaves.append([(idx, vals, tuple(np.shape(ref)))])
         return _SparseStage(leaves)
 
-    def _scatter_fold(self, stage: _SparseStage) -> Any:
+    def add_partial(self, key: str, total_w: float, tree: Any,
+                    loss_sum: float, count: int = 1) -> None:
+        """Stage one PRE-FOLDED partial sum (an aggregator's slice fold):
+        ``tree`` is the slice's weighted-sum tree (dense host leaves, or
+        ``None`` for a slice that folded nothing), ``total_w``/``loss_sum``
+        the slice's accumulated weight and weighted loss.  :meth:`finalize`
+        combines partials sequentially in ``order`` — the cross-block sum
+        of the slice-blocked flat fold, so root-side combination is
+        bitwise identical to a flat folder built with the same
+        ``slices``."""
+        if self._finalized:
+            raise RuntimeError("StreamingFolder already finalized")
+        t0 = time.perf_counter()
+        contrib = None
+        if tree is not None:
+            contrib = jax.tree.map(np.asarray, tree)
+            if self._placement is not None:
+                # Slicing commutes elementwise with the adds below, so the
+                # sharded combine stays bitwise equal to the replicated one.
+                contrib = self._placement.slice_tree(contrib)
+        self._staged[str(key)] = (float(total_w), contrib, float(loss_sum))
+        self.count += int(count)
+        self.fold_s += time.perf_counter() - t0
+
+    def _scatter_fold(self, acc: Any, stage: _SparseStage) -> Any:
         """Fold one sparse-staged contribution into the accumulator.
 
         First contribution: densify by ASSIGNMENT into fresh zeros —
@@ -201,7 +245,7 @@ class StreamingFolder(UpdateFolder):
         when schemes are mixed within one cohort, which no config
         produces) is copied once before the scatter."""
         treedef = jax.tree.structure(self.shapes)
-        if self.wsum is None:
+        if acc is None:
             out = []
             for shards in stage.leaves:
                 parts = []
@@ -213,7 +257,7 @@ class StreamingFolder(UpdateFolder):
                 out.append(tuple(parts) if self._placement is not None
                            else parts[0])
             return jax.tree.unflatten(treedef, out)
-        acc_leaves = treedef.flatten_up_to(self.wsum)
+        acc_leaves = treedef.flatten_up_to(acc)
         new_leaves = []
         for acc, shards in zip(acc_leaves, stage.leaves):
             sharded = isinstance(acc, tuple)
@@ -230,10 +274,29 @@ class StreamingFolder(UpdateFolder):
             new_leaves.append(tuple(targets) if sharded else targets[0])
         return jax.tree.unflatten(treedef, new_leaves)
 
+    def _fold_block(self, ids: Sequence[str]) -> tuple[Any, float, float]:
+        """Fold one block of staged ids sequentially from scratch —
+        weighted sum, total weight and weighted loss all accumulate
+        block-locally (exactly what a slice aggregator computes)."""
+        acc, tw, ls = None, 0.0, 0.0
+        for cid in ids:
+            w, contrib, loss_w = self._staged[cid]
+            if isinstance(contrib, _SparseStage):
+                acc = self._scatter_fold(acc, contrib)
+            elif contrib is not None:
+                acc = (contrib if acc is None
+                       else pytrees.tree_add(acc, contrib))
+            tw += w
+            ls += loss_w
+        return acc, tw, ls
+
     def finalize(self) -> None:
         """Sum the staged contributions in cohort order (idempotent).
         Must run before :meth:`mean` or any direct ``wsum`` consumer
-        (secure-agg unmasking mutates ``wsum`` after this)."""
+        (secure-agg unmasking mutates ``wsum`` after this).  With
+        ``slices`` the sum is regrouped at the block boundaries — see the
+        class docstring; without, one block reproduces the historical
+        single-pass fold bitwise."""
         if self._finalized:
             return
         self._finalized = True
@@ -241,17 +304,27 @@ class StreamingFolder(UpdateFolder):
                  else sorted(self._staged))
         ids = [cid for cid in order if cid in self._staged]
         ids += [cid for cid in self._staged if cid not in ids]
-        for cid in ids:
-            w, contrib, loss_w = self._staged[cid]
-            if isinstance(contrib, _SparseStage):
-                self.wsum = self._scatter_fold(contrib)
-            else:
-                self.wsum = (
-                    contrib if self.wsum is None
-                    else pytrees.tree_add(self.wsum, contrib)
-                )
-            self.total_w += w
-            self.loss_sum += loss_w
+        if self._slices is None:
+            blocks = [ids]
+        else:
+            covered: set[str] = set()
+            blocks = []
+            for sl in self._slices:
+                covered.update(str(c) for c in sl)
+                blk = [str(c) for c in sl if str(c) in self._staged]
+                if blk:
+                    blocks.append(blk)
+            stragglers = [cid for cid in ids if cid not in covered]
+            if stragglers:
+                blocks.append(stragglers)
+            ids = [cid for blk in blocks for cid in blk]
+        for blk in blocks:
+            acc, tw, ls = self._fold_block(blk)
+            if acc is not None:
+                self.wsum = (acc if self.wsum is None
+                             else pytrees.tree_add(self.wsum, acc))
+            self.total_w += tw
+            self.loss_sum += ls
         self.folded_ids = ids
         self._staged.clear()
 

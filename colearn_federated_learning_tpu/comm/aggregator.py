@@ -1,0 +1,352 @@
+"""Aggregator tier: distributed ingest between devices and the root.
+
+One coordinator folding every uplink byte caps the federation at a
+single host's ingest bandwidth and fold CPU (ROADMAP "Distributed
+aggregator tier"; the DisAgg / NET-SA composition result in PAPERS.md).
+This module is the middle tier that removes the cap: N real
+:class:`AggregatorServer` processes each own a contiguous slice of the
+round cohort, run the SAME sparse-native :class:`StreamingFolder` the
+root runs (comm/aggregation.py) over their slice, and emit ONE partial
+sum upstream — so the root folds N partials instead of C cohort
+updates, and per-process ingest bytes / fold CPU scale ~1/N
+(``bench_fleet.py --ingest-sweep`` prices it).
+
+Exactness: the root's cross-partial combine is float addition REGROUPED
+at the slice boundaries, which is exactly what
+``StreamingFolder(slices=...)`` computes flat — the parity tests pin
+the tree fold BITWISE against that slice-blocked flat fold (dense and
+topk uplinks, full and partial cohorts, replicated and tp-sharded
+root).  With one aggregator the tree fold is bitwise identical to the
+historical flat fold outright.
+
+Robustness (the headline, not a footnote):
+
+- every aggregator heartbeats a RETAINED broker record
+  (``colearn/agg/<id>``, fresh ``ts`` each beat); the root checks
+  heartbeat age before dispatch (bounded-deadline detection,
+  ``run.agg_heartbeat_timeout``) and counts expiries;
+- a fold request that fails — dead heartbeat, SIGKILLed process,
+  connection reset mid-fold — RE-HOMES its whole slice to a surviving
+  sibling aggregator inside the same round budget
+  (``comm.agg_failovers_total{action="rehome"}``); only when no sibling
+  survives does the slice quorum-drop with renormalization
+  (``action="drop"`` — the mean divides by the folded weight, so the
+  round stays well-defined).  ``faults/procsoak.run_agg_soak`` chaos-
+  gates this with a real mid-round SIGKILL against a flat oracle.
+
+Secure-agg composition: pairwise masks cancel within any COMPLETE sum,
+so the root passes each device its SLICE as the pairing cohort — every
+mask pair lives inside one aggregator's partial, each partial stays
+unopenable (self-masks come off only at the root's per-slice recovery),
+and a fully-dropped slice orphans no mask halves at all.
+
+The aggregator is model-agnostic: it decodes the relayed broadcast
+frame into the global-params tree (that IS its shapes template),
+re-encodes it once, and fans the shared frame out to its slice —
+serialize-once preserved per tier.  ``compress_down`` must be ``none``
+in tree mode (the resync protocol is not relayed; the coordinator
+validates eagerly).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import math
+import threading
+import time
+from typing import Any, Optional, Sequence
+
+from colearn_federated_learning_tpu.comm.broker import BrokerClient
+from colearn_federated_learning_tpu.comm import protocol
+from colearn_federated_learning_tpu.comm.transport import (
+    TensorClient,
+    TensorServer,
+)
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.utils.config import ExperimentConfig
+
+# Retained announce/heartbeat topic per aggregator (control plane).
+AGG_TOPIC = "colearn/agg/"
+
+
+def slice_cohort(cohort: Sequence[Any], n: int) -> list[list[Any]]:
+    """Partition ``cohort`` (already in cohort order) into ``n``
+    contiguous slices whose sizes differ by at most one — the tree's
+    slice layout AND the flat parity oracle's block layout, so both
+    sides regroup the fold sum identically.  Slices may be empty when
+    ``n`` exceeds the cohort."""
+    n = max(1, int(n))
+    base, rem = divmod(len(cohort), n)
+    out, start = [], 0
+    for i in range(n):
+        size = base + (1 if i < rem else 0)
+        out.append(list(cohort[start:start + size]))
+        start += size
+    return out
+
+
+class AggregatorServer:
+    """One aggregator process: a tensor server folding its device slice.
+
+    Serves ``{"op": "fold"}`` requests from the root: the request body
+    is the round's broadcast frame (decoded to the params tree by the
+    transport), the header carries the slice's device addresses, the
+    (slice-local) secure-agg cohort and relayed share inboxes.  The
+    reply is the slice's weighted-sum tree plus fold bookkeeping
+    (``total_w``, ``loss_sum``, ``folded_ids``, ``failed``, ``stale``).
+    """
+
+    def __init__(self, config: ExperimentConfig, agg_id: int,
+                 broker_host: Optional[str] = None,
+                 broker_port: Optional[int] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_s: float = 0.5):
+        self.config = config
+        self.agg_id = int(agg_id)
+        self._server = TensorServer(self._handle, host=host, port=port,
+                                    ident=f"agg:{self.agg_id}")
+        self._broker_addr = (broker_host, broker_port)
+        self._broker: Optional[BrokerClient] = None
+        self.heartbeat_s = float(heartbeat_s)
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+        # Retry policy mirrors the root's (config.run.comm_retries) so a
+        # flaky device gets the same second chance either way.
+        from colearn_federated_learning_tpu.comm.transport import RetryPolicy
+
+        self.retry = (
+            RetryPolicy(max_retries=config.run.comm_retries,
+                        backoff_base=config.run.comm_backoff_base,
+                        backoff_max=config.run.comm_backoff_max)
+            if config.run.comm_retries > 0 else None
+        )
+
+    # ------------------------------------------------------------------
+    @property
+    def host(self) -> str:
+        return self._server.host
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> "AggregatorServer":
+        self._server.start()
+        bh, bp = self._broker_addr
+        if bh is not None:
+            self._broker = BrokerClient(bh, bp,
+                                        timeout=protocol.CONNECT_TIMEOUT)
+            self._announce()
+            self._hb = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"agg-{self.agg_id}-heartbeat", daemon=True)
+            self._hb.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(timeout=2.0)
+        self._server.stop()
+        if self._broker is not None:
+            self._broker.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _announce(self) -> None:
+        self._broker.publish(AGG_TOPIC + str(self.agg_id), {
+            "agg_id": self.agg_id, "host": self.host, "port": self.port,
+            "ts": time.time(),
+        }, retain=True)
+
+    def _heartbeat_loop(self) -> None:
+        """Republish the retained announce with a fresh ``ts`` every
+        beat — the root's liveness signal.  A dead broker is reconnected
+        with the same heal-in-place pattern as the worker watchdog (the
+        retained record died with the old broker)."""
+        bh, bp = self._broker_addr
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                if self._broker is None or not self._broker.alive():
+                    fresh = BrokerClient(bh, bp,
+                                         timeout=protocol.CONNECT_TIMEOUT)
+                    if self._broker is not None:
+                        self._broker.close()
+                    self._broker = fresh
+                self._announce()
+            except OSError:
+                protocol.count_suppressed()   # broker down: retry next beat
+                continue
+
+    # ------------------------------------------------------------------
+    def _handle(self, header: dict, tree: Any) -> tuple[dict, Any]:
+        op = header.get("op")
+        if op == "fold":
+            return self._fold(header, tree)
+        if op == "info":
+            return ({"meta": {"agg_id": self.agg_id,
+                              "host": self.host, "port": self.port}}, None)
+        return ({"status": "error", "error": f"unknown op {op!r}"}, None)
+
+    def _fold(self, header: dict, tree: Any) -> tuple[dict, Any]:
+        """Relay the broadcast to this slice's devices, fold the replies
+        sparse-natively, reply with ONE partial sum."""
+        from colearn_federated_learning_tpu.comm.aggregation import (
+            StreamingFolder,
+        )
+        from colearn_federated_learning_tpu.utils.serialization import (
+            pytree_to_bytes,
+        )
+
+        if tree is None:
+            return ({"status": "error",
+                     "error": "fold request carried no params frame"}, None)
+        r = int(header.get("round", 0))
+        devices = header.get("devices") or []
+        cohort = header.get("cohort")
+        shares_in = header.get("shares_in") or {}
+        budget = float(header.get("timeout", 30.0))
+        meta_in = header.get("meta") or {}
+        # Serialize-once per tier: ONE re-encode of the decoded broadcast,
+        # shared read-only by every slice send below.
+        body = memoryview(pytree_to_bytes(tree, meta_in or None))
+        # The decoded params tree IS the shapes template (StreamingFolder
+        # only reads leaf shapes), so the aggregator needs no model code.
+        order = [str(int(d[0])) for d in devices]
+        folder = StreamingFolder(tree, order=order)
+        stale: list[str] = []
+        failed: list[str] = []
+        deadline = time.monotonic() + budget
+
+        def ask(dev):
+            did, dhost, dport = str(int(dev[0])), str(dev[1]), int(dev[2])
+            req = {"op": "train", "round": r}
+            if cohort is not None:
+                req["cohort"] = cohort
+            inbox = shares_in.get(did)
+            if inbox:
+                req["shares_in"] = inbox
+            cli = TensorClient(dhost, dport, timeout=protocol.CONNECT_TIMEOUT,
+                               ident=did)
+            try:
+                hdr, delta = cli.request(req, body=body, timeout=budget,
+                                         retry=self.retry, deadline=deadline)
+                if hdr.get("status") != "ok":
+                    raise RuntimeError(f"{did}: {hdr.get('error')}")
+                return hdr["meta"], delta
+            finally:
+                cli.close()
+
+        if devices:
+            with cf.ThreadPoolExecutor(
+                    max_workers=len(devices),
+                    thread_name_prefix=f"agg{self.agg_id}-fanout") as pool:
+                futs = {pool.submit(ask, d): str(int(d[0])) for d in devices}
+                pending = dict(futs)
+
+                def take(fut, did):
+                    try:
+                        meta, delta = fut.result()
+                    except Exception:
+                        failed.append(did)
+                        return
+                    if int(meta.get("round", r)) != r:
+                        stale.append(str(meta.get("client_id", did)))
+                        return
+                    folder.add(meta, delta)
+
+                try:
+                    for fut in cf.as_completed(futs, timeout=budget):
+                        take(fut, pending.pop(fut))
+                except cf.TimeoutError:     # colearn: noqa(CL003)
+                    pass    # stragglers: charged below, like the root's
+                for fut, did in pending.items():
+                    if fut.done():
+                        # Completed in the race window after as_completed
+                        # gave up — the reply is here, use it (same
+                        # leniency as the root's fan-out).
+                        take(fut, did)
+                    else:
+                        fut.cancel()
+                        failed.append(did)
+        folder.finalize()
+        reg = telemetry.get_registry()
+        reg.counter("comm.agg_folds_total",
+                    labels={"agg": str(self.agg_id)}).inc()
+        out_meta = {
+            "agg_id": self.agg_id,
+            "round": r,
+            "total_w": folder.total_w,
+            "loss_sum": folder.loss_sum,
+            "folded_ids": folder.folded_ids,
+            "failed": sorted(set(failed), key=order.index),
+            "stale": stale,
+            "fold_s": folder.fold_s,
+            "densify_avoided": folder.densify_avoided,
+        }
+        if folder.wsum is None:
+            return ({"meta": out_meta}, None)
+        return ({"meta": out_meta}, folder.wsum)
+
+
+def combine_partial_weights(total_ws: Sequence[float]) -> float:
+    """Root-side sequential sum of partial weights — split out so the
+    bench and tests share the exact arithmetic the coordinator runs."""
+    total = 0.0
+    for t in total_ws:
+        total += float(t)
+    return total
+
+
+def run_aggregator_forever(config: ExperimentConfig, agg_id: int,
+                           broker_host: str, broker_port: int,
+                           heartbeat_s: float = 0.5) -> None:
+    """CLI entry: announce, heartbeat, serve folds until killed."""
+    agg = AggregatorServer(config, agg_id, broker_host, broker_port,
+                           heartbeat_s=heartbeat_s).start()
+    try:
+        threading.Event().wait()
+    finally:
+        agg.stop()
+
+
+def fetch_aggregators(sub: BrokerClient, known: dict,
+                      drain_timeout: float = 0.05) -> dict:
+    """Drain the retained ``colearn/agg/#`` subscription into ``known``
+    (``agg_id -> {"host", "port", "ts"}``, latest record wins).  The
+    root calls this at enrollment and before every tree dispatch — the
+    heartbeat ``ts`` it refreshes is the bounded-deadline liveness
+    signal."""
+    while True:
+        try:
+            header, _ = sub.recv(timeout=drain_timeout)
+        except TimeoutError:
+            return known
+        if not str(header.get("topic", "")).startswith(AGG_TOPIC):
+            continue
+        try:
+            agg_id = int(header["agg_id"])
+            known[agg_id] = {"host": str(header["host"]),
+                             "port": int(header["port"]),
+                             "ts": float(header.get("ts", 0.0))}
+        except (KeyError, TypeError, ValueError):
+            protocol.count_suppressed()   # malformed announce: never crash
+            continue
+
+
+def expected_ingest(cohort: int, n_aggregators: int, update_bytes: int,
+                    partial_bytes: int) -> dict:
+    """Analytic per-round ingest bill of the tree (shape-only pricing,
+    same convention as the wire bench): each aggregator ingests
+    ``ceil(C/N)`` device update frames; the root ingests ``N`` partial
+    frames instead of ``C`` update frames."""
+    per_agg_devices = math.ceil(cohort / max(1, n_aggregators))
+    return {
+        "agg_ingest_bytes": per_agg_devices * update_bytes,
+        "root_ingest_bytes": n_aggregators * partial_bytes,
+        "flat_root_ingest_bytes": cohort * update_bytes,
+    }

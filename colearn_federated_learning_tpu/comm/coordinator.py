@@ -87,6 +87,28 @@ class FederatedCoordinator:
                 f"{config.fed.secure_agg_threshold}"
             )
         validate_robustness(config)
+        # Aggregator tree (comm/aggregator.py): with run.num_aggregators
+        # > 0 the train fan-out goes through N aggregator processes, each
+        # folding a contiguous cohort slice; the root folds N partials.
+        self.num_aggregators = int(
+            getattr(config.run, "num_aggregators", 0) or 0)
+        if self.num_aggregators and config.fed.compress_down != "none":
+            raise ValueError(
+                "the aggregator tree requires compress_down='none': the "
+                "per-device resync protocol is not relayed through the "
+                "fold tier"
+            )
+        self._aggs: dict[int, dict] = {}       # agg_id -> host/port/ts
+        self._agg_clients: dict[int, TensorClient] = {}
+        self._agg_sub: Optional[BrokerClient] = None
+        self.agg_heartbeat_timeout = float(
+            getattr(config.run, "agg_heartbeat_timeout", 5.0) or 5.0)
+        # WAL-backed enrollment ledger (ckpt/wal.EnrollmentLedger): every
+        # admission is recorded durably so a resumed coordinator verifies
+        # devices against the LEDGER (challenge-on-resume), never against
+        # replayable retained broker announcements alone.
+        self._ledger = None
+        self._ledger_prior: Optional[dict] = None
         self.round_timeout = round_timeout
         # Share-distribution deadline as a fraction of the round budget:
         # a masker too slow to distribute its recovery shares is PRUNED
@@ -213,7 +235,10 @@ class FederatedCoordinator:
 
     # ------------------------------------------------------------------
     def enroll(self, min_devices: int, timeout: float = 30.0) -> None:
-        """Wait for devices, assign roles, open tensor connections."""
+        """Wait for devices, assign roles, open tensor connections.
+        Every admission is appended to the durable enrollment ledger
+        (when a checkpoint_dir is configured) — the record challenge-on-
+        resume verifies against."""
         self._enroll.wait_for(min_devices, timeout)
         self.trainers, self.evaluator = self._enroll.assign_roles(
             want_evaluator=self.want_evaluator
@@ -222,8 +247,185 @@ class FederatedCoordinator:
             self._clients[d.device_id] = TensorClient(
                 d.host, d.port, timeout=protocol.CONNECT_TIMEOUT,
                 ident=d.device_id)
+            self._ledger_admit(d)
+
+    # ---- durable enrollment + challenge-on-resume ------------------------
+    def _enroll_ledger(self):
+        if self._ledger is None and self.config.run.checkpoint_dir:
+            from colearn_federated_learning_tpu.ckpt import EnrollmentLedger
+
+            self._ledger = EnrollmentLedger(self.config.run.checkpoint_dir)
+            # What the PREVIOUS incarnation admitted, captured before this
+            # process appends anything: challenge-on-resume verifies
+            # against these bindings.  The fresh appends made by this
+            # process's own enroll() come straight from the replayable
+            # announcements the challenge exists to distrust — verifying
+            # against them would let an impostor mint its own binding.
+            self._ledger_prior = self._ledger.devices()
+        return self._ledger
+
+    def _ledger_admit(self, d: DeviceInfo) -> None:
+        ledger = self._enroll_ledger()
+        if ledger is not None:
+            ledger.admit(d)
+
+    def verify_resumed_devices(self) -> dict:
+        """Challenge-on-resume: after a resumed coordinator re-enrolls,
+        readmit ONLY devices the durable ledger knows — and, when the
+        ledger holds an identity pubkey for a device, only after the
+        device proves possession of the matching private key (nonce echo
+        under a fresh ephemeral DH pairing; `comm/keyexchange.py`).  A
+        retained broker announcement alone — replayable, forgeable by
+        anyone who can publish — no longer readmits anybody.  Rejected
+        devices are dropped from the federation and counted in
+        ``comm.enroll_challenge_rejected_total{reason}``.  Ledger entries
+        without a pubkey (devices enrolled by a pre-ledger build) are
+        admitted on ledger presence alone — documented trust step-down,
+        closed the first time the device re-enrolls with a key."""
+        import hashlib
+        import os
+
+        from colearn_federated_learning_tpu.comm import keyexchange
+
+        ledger = self._enroll_ledger()
+        reg = telemetry.get_registry()
+        out = {"verified": [], "rejected": []}
+        if ledger is None:
+            return out
+        # Verify against the bindings the PREVIOUS incarnation recorded
+        # (snapshotted before this process's enroll() appended anything),
+        # NOT the live ledger: the live tail was just written from the
+        # very announcements the challenge distrusts.
+        known = self._ledger_prior or {}
+        eph_priv, eph_pub = keyexchange.generate_keypair()
+        pub_s = keyexchange.encode_public(eph_pub)
+
+        def reject(dev: DeviceInfo, reason: str) -> None:
+            reg.counter("comm.enroll_challenge_rejected_total",
+                        labels={"reason": reason}).inc()
+            # Retract the admission this enrollment just replay-recorded,
+            # so the rejected device cannot pass a FUTURE resume on it.
+            ledger.revoke(dev.device_id)
+            out["rejected"].append(dev.device_id)
+            self.trainers = [t for t in self.trainers
+                             if t.device_id != dev.device_id]
+            if (self.evaluator is not None
+                    and self.evaluator.device_id == dev.device_id):
+                self.evaluator = None
+            cli = self._clients.pop(dev.device_id, None)
+            if cli is not None:
+                cli.close()
+
+        devices = list(self.trainers)
+        if self.evaluator is not None:
+            devices.append(self.evaluator)
+        for dev in devices:
+            rec = known.get(str(dev.device_id))
+            if rec is None:
+                reject(dev, "not_in_ledger")
+                continue
+            pubkey = rec.get("pubkey", "")
+            if not pubkey:
+                out["verified"].append(dev.device_id)
+                continue
+            nonce = os.urandom(16).hex()
+            try:
+                secret = keyexchange.shared_secret(
+                    eph_priv, keyexchange.decode_public(pubkey))
+            except ValueError:
+                reject(dev, "bad_ledger_key")
+                continue
+            expect = hashlib.sha256(
+                secret + bytes.fromhex(nonce)).hexdigest()
+            try:
+                header, _ = self._clients[dev.device_id].request(
+                    {"op": "challenge", "nonce": nonce, "pub": pub_s},
+                    timeout=self.round_timeout,
+                )
+                tag = (header.get("meta") or {}).get("tag", "")
+            except (OSError, protocol.ConnectionClosed, TimeoutError):
+                reject(dev, "unreachable")
+                continue
+            if header.get("status") != "ok" or tag != expect:
+                # Forged announcement: whoever answered does not hold the
+                # private key the ledger bound this device_id to.
+                reject(dev, "bad_tag")
+                continue
+            out["verified"].append(dev.device_id)
+        return out
+
+    # ---- aggregator tier (comm/aggregator.py) ----------------------------
+    def enroll_aggregators(self, n: Optional[int] = None,
+                           timeout: float = 30.0) -> list[int]:
+        """Discover ``n`` live aggregators from their retained announce
+        records and open tensor connections to them.  Raises
+        ``TimeoutError`` when fewer than ``n`` announce in time."""
+        from colearn_federated_learning_tpu.comm import aggregator as agg_lib
+
+        n = self.num_aggregators if n is None else int(n)
+        if self._agg_sub is None:
+            self._agg_sub = BrokerClient(self._broker_addr[0],
+                                         self._broker_addr[1],
+                                         timeout=protocol.CONNECT_TIMEOUT)
+            self._agg_sub.subscribe(agg_lib.AGG_TOPIC + "#")
+        deadline = time.monotonic() + timeout
+        while True:
+            agg_lib.fetch_aggregators(self._agg_sub, self._aggs,
+                                      drain_timeout=0.2)
+            if len(self._aggs) >= n:
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"only {len(self._aggs)}/{n} aggregators announced "
+                    f"within {timeout:.0f}s"
+                )
+        for agg_id in sorted(self._aggs):
+            self._agg_connect(agg_id)
+        return sorted(self._aggs)
+
+    def _agg_connect(self, agg_id: int) -> None:
+        info = self._aggs[agg_id]
+        old = self._agg_clients.pop(agg_id, None)
+        if old is not None:
+            old.close()
+        try:
+            self._agg_clients[agg_id] = TensorClient(
+                info["host"], info["port"], timeout=protocol.CONNECT_TIMEOUT,
+                ident=f"agg:{agg_id}")
+        except OSError:
+            telemetry.get_registry().counter(
+                "comm.reconnect_failures_total").inc()
+
+    def _live_aggregators(self) -> list[int]:
+        """Aggregators whose retained heartbeat is fresher than the
+        bounded detection deadline; expiries are counted."""
+        from colearn_federated_learning_tpu.comm import aggregator as agg_lib
+
+        if self._agg_sub is not None:
+            try:
+                agg_lib.fetch_aggregators(self._agg_sub, self._aggs,
+                                          drain_timeout=0.02)
+            except protocol.ConnectionClosed:
+                self._agg_sub = None    # broker died; rebuilt on reconnect
+        now = time.time()
+        live = []
+        reg = telemetry.get_registry()
+        for agg_id in sorted(self._aggs):
+            if now - self._aggs[agg_id]["ts"] <= self.agg_heartbeat_timeout:
+                live.append(agg_id)
+            else:
+                reg.counter("comm.agg_heartbeat_expired_total").inc()
+        return live
 
     def close(self) -> None:
+        for c in self._agg_clients.values():
+            c.close()
+        if self._agg_sub is not None:
+            self._agg_sub.close()
+            self._agg_sub = None
+        if self._ledger is not None:
+            self._ledger.close()
+            self._ledger = None
         for c in self._clients.values():
             c.close()
         if self._pool is not None:
@@ -263,9 +465,15 @@ class FederatedCoordinator:
             # manager's retained-topic subscription replays them.
             self._rebuild_broker()
         try:
-            return admit_late_joiners(self._enroll, self._broker,
-                                      self.trainers, self.evaluator,
-                                      self._clients, poll)
+            admitted = admit_late_joiners(self._enroll, self._broker,
+                                          self.trainers, self.evaluator,
+                                          self._clients, poll)
+            if admitted:
+                admitted_set = set(admitted)
+                for d in self.trainers:
+                    if d.device_id in admitted_set:
+                        self._ledger_admit(d)
+            return admitted
         except (OSError, protocol.ConnectionClosed):
             # Broker died between the liveness check and the poll/publish
             # (a SIGKILL mid-recv surfaces as ConnectionClosed, not
@@ -456,8 +664,29 @@ class FederatedCoordinator:
         round_t0 = time.monotonic()
         secure = self.config.fed.secure_agg
         dh = secure and self.config.fed.secure_agg_key_exchange == "dh"
+        tree_mode = self.num_aggregators > 0
         share_info = None
         pruned: list[str] = []
+        slices_full: list[list[DeviceInfo]] = []
+        cohort_of = None
+        if tree_mode:
+            # Slice layout is fixed over the SAMPLED cohort, before any
+            # share-phase pruning, so the pairing cohort each device sees
+            # at share_setup matches its slice at train time the same way
+            # the flat path's pre-prune cohort does.  Group-local masking
+            # aligned to slices: every mask pair lives inside ONE
+            # aggregator's partial, which therefore stays unopenable.
+            from colearn_federated_learning_tpu.comm import (
+                aggregator as agg_lib,
+            )
+
+            slices_full = agg_lib.slice_cohort(cohort, self.num_aggregators)
+            if secure:
+                cohort_of = {}
+                for sl in slices_full:
+                    ids = sorted(int(d.device_id) for d in sl)
+                    for d in sl:
+                        cohort_of[d.device_id] = ids
         if dh:
             # Phase 1 of the dropout-tolerant round: every cohort member
             # distributes this round's recovery shares BEFORE any mask is
@@ -465,7 +694,8 @@ class FederatedCoordinator:
             # from the cohort — they never mask, so their death can never
             # orphan a mask half (privacy/dropout.py).
             with self.tracer.span("share_setup", cohort=len(cohort)):
-                share_info, share_failed = self._share_phase(r, cohort, ctx)
+                share_info, share_failed = self._share_phase(
+                    r, cohort, ctx, cohort_of=cohort_of)
             if share_failed:
                 pruned = [d.device_id for d in share_failed]
                 cut = set(pruned)
@@ -482,69 +712,96 @@ class FederatedCoordinator:
         cohort_ids = sorted(int(d.device_id) for d in cohort)
         reg = telemetry.get_registry()
 
-        def train_req(dev: DeviceInfo):
-            req = protocol.attach_trace({"op": "train", "round": r}, ctx)
-            if secure:
-                req["cohort"] = cohort_ids
-            if share_info is not None:
-                # This device's inbox of peer share ciphertexts rides the
-                # (per-device) request header; the broadcast body itself
-                # stays the shared serialize-once frame.
-                inbox = share_info["to"].get(dev.device_id)
-                if inbox:
-                    req["shares_in"] = inbox
-            return req
-
-        def ask(dev: DeviceInfo, deadline: float):
-            header, delta = self._request(dev, train_req(dev), body=body,
-                                          deadline=deadline)
-            if header.get("status") == "resync" and resync_body is not None:
-                # Cache miss on the worker (restart / skipped round): pay
-                # one full-params send for THIS device; the rest of the
-                # cohort keeps the compressed frame.
-                reg.counter("comm.resync_total").inc()
-                header, delta = self._request(dev, train_req(dev),
-                                              body=resync_body(),
-                                              deadline=deadline)
-            elif saved:
-                reg.counter("comm.bytes_saved_downlink").inc(saved)
-            if header.get("status") != "ok":
-                raise RuntimeError(f"{dev.device_id}: {header.get('error')}")
-            if self._uplink_saved_per_update:
-                reg.counter("comm.bytes_saved_uplink").inc(
-                    self._uplink_saved_per_update)
-            return header["meta"], delta
-
         from colearn_federated_learning_tpu.comm.aggregation import (
             StreamingFolder,
         )
 
-        # Fold order (hence every float sum) is pinned to COHORT order by
-        # the StreamingFolder regardless of reply timing, so streaming
-        # changes round records not at all — see StreamingFolder docstring.
-        folder = StreamingFolder(
-            self._shapes_np, order=[str(int(d.device_id)) for d in cohort],
-            placement=self._placement)
         stale: list[str] = []
+        tree_stats: Optional[dict] = None
+        if tree_mode:
+            # Survivors of the share phase, still grouped by the ORIGINAL
+            # slice layout (pairing cohorts were fixed pre-prune).
+            alive = {d.device_id for d in cohort}
+            slices = [[d for d in sl if d.device_id in alive]
+                      for sl in slices_full]
+            # The root folds one partial per slice; the slice-keyed order
+            # regroups the float sum exactly like the flat fold with
+            # ``slices=`` (see aggregator.py module docstring on parity).
+            folder = StreamingFolder(
+                self._shapes_np,
+                order=[f"slice:{i}" for i in range(len(slices))],
+                placement=self._placement)
+            with self.tracer.span("broadcast_collect",
+                                  cohort=len(cohort)) as collect_sp:
+                train_timeout = max(1.0, self.round_timeout
+                                    - (time.monotonic() - round_t0))
+                tree_stats = self._tree_collect(
+                    r, slices, body, share_info, folder, train_timeout,
+                    secure, stale, ctx)
+            dropped = pruned + tree_stats["failed"]
+        else:
+            def train_req(dev: DeviceInfo):
+                req = protocol.attach_trace({"op": "train", "round": r}, ctx)
+                if secure:
+                    req["cohort"] = cohort_ids
+                if share_info is not None:
+                    # This device's inbox of peer share ciphertexts rides
+                    # the (per-device) request header; the broadcast body
+                    # itself stays the shared serialize-once frame.
+                    inbox = share_info["to"].get(dev.device_id)
+                    if inbox:
+                        req["shares_in"] = inbox
+                return req
 
-        def fold(dev: DeviceInfo, res) -> None:
-            meta, delta = res
-            _pop_worker_spans(meta, self.tracer)
-            if int(meta.get("round", r)) != r:   # stale update: refuse
-                stale.append(str(meta.get("client_id")))
-                return
-            folder.add(meta, delta)
+            def ask(dev: DeviceInfo, deadline: float):
+                header, delta = self._request(dev, train_req(dev), body=body,
+                                              deadline=deadline)
+                if (header.get("status") == "resync"
+                        and resync_body is not None):
+                    # Cache miss on the worker (restart / skipped round):
+                    # pay one full-params send for THIS device; the rest
+                    # of the cohort keeps the compressed frame.
+                    reg.counter("comm.resync_total").inc()
+                    header, delta = self._request(dev, train_req(dev),
+                                                  body=resync_body(),
+                                                  deadline=deadline)
+                elif saved:
+                    reg.counter("comm.bytes_saved_downlink").inc(saved)
+                if header.get("status") != "ok":
+                    raise RuntimeError(
+                        f"{dev.device_id}: {header.get('error')}")
+                if self._uplink_saved_per_update:
+                    reg.counter("comm.bytes_saved_uplink").inc(
+                        self._uplink_saved_per_update)
+                return header["meta"], delta
 
-        with self.tracer.span("broadcast_collect",
-                              cohort=len(cohort)) as collect_sp:
-            # The train fan-out races what REMAINS of the round budget
-            # after the share phase — pruning late maskers must not
-            # stretch the round past its one deadline.
-            train_timeout = max(1.0, self.round_timeout
-                                - (time.monotonic() - round_t0))
-            results, failed = self._fan_out(cohort, ask, on_result=fold,
-                                            timeout=train_timeout)
-        dropped = pruned + [d.device_id for d in failed]
+            # Fold order (hence every float sum) is pinned to COHORT order
+            # by the StreamingFolder regardless of reply timing, so
+            # streaming changes round records not at all — see
+            # StreamingFolder docstring.
+            folder = StreamingFolder(
+                self._shapes_np,
+                order=[str(int(d.device_id)) for d in cohort],
+                placement=self._placement)
+
+            def fold(dev: DeviceInfo, res) -> None:
+                meta, delta = res
+                _pop_worker_spans(meta, self.tracer)
+                if int(meta.get("round", r)) != r:   # stale update: refuse
+                    stale.append(str(meta.get("client_id")))
+                    return
+                folder.add(meta, delta)
+
+            with self.tracer.span("broadcast_collect",
+                                  cohort=len(cohort)) as collect_sp:
+                # The train fan-out races what REMAINS of the round budget
+                # after the share phase — pruning late maskers must not
+                # stretch the round past its one deadline.
+                train_timeout = max(1.0, self.round_timeout
+                                    - (time.monotonic() - round_t0))
+                results, failed = self._fan_out(cohort, ask, on_result=fold,
+                                                timeout=train_timeout)
+            dropped = pruned + [d.device_id for d in failed]
 
         with self.tracer.span("aggregate") as agg_sp:
             folder.finalize()
@@ -555,7 +812,10 @@ class FederatedCoordinator:
                        for i, d in enumerate(cohort)}
                 dropped.extend(sorted(stale,
                                       key=lambda c: pos.get(c, len(pos))))
-            received = [int(c) for c in folder.folded_ids]
+            # Tree mode: folded_ids are slice keys; device membership
+            # comes from the partial metas (slice order, so deterministic).
+            received = (tree_stats["received"] if tree_mode
+                        else [int(c) for c in folder.folded_ids])
             folded = folder.count
             # Accepted-update manifest for the round WAL (crash recovery);
             # deliberately NOT part of the round record, whose byte layout
@@ -574,21 +834,37 @@ class FederatedCoordinator:
 
             missing = sorted(set(cohort_ids) - set(received))
             unmask_failed = False
-            if secure and folded and not skipped_quorum:
-                if dh:
-                    # Share-based recovery runs EVERY dh round: folded
-                    # clients' self-masks must come off even when nobody
-                    # dropped (privacy/dropout.py double-mask).
-                    with self.tracer.span("unmask", dropped=len(missing)):
-                        unmask_failed = not self._recover_dh(
-                            r, cohort_ids, received, missing, folder,
-                            share_info
-                        )
-                elif missing:
-                    with self.tracer.span("unmask", dropped=len(missing)):
-                        unmask_failed = not self._recover_shared_seed(
-                            r, cohort_ids, received, missing, folder
-                        )
+            if secure and folded and not skipped_quorum and (dh or missing):
+                # Masks pair within a GROUP: the whole cohort flat, or one
+                # aggregator slice in tree mode (group-local masking).
+                # Each group with any folded member gets its own recovery
+                # pass; a fully-dropped slice orphans no mask halves, so
+                # it needs none.
+                if tree_mode:
+                    groups = [(ids, recv) for ids, recv
+                              in zip(tree_stats["slice_ids"],
+                                     tree_stats["slice_received"])
+                              if recv]
+                else:
+                    groups = [(cohort_ids, received)]
+                with self.tracer.span("unmask", dropped=len(missing)):
+                    for g_ids, g_recv in groups:
+                        g_miss = sorted(set(g_ids) - set(g_recv))
+                        if dh:
+                            # Share-based recovery runs EVERY dh round:
+                            # folded clients' self-masks must come off even
+                            # when nobody dropped (privacy/dropout.py
+                            # double-mask).
+                            ok = self._recover_dh(r, g_ids, g_recv, g_miss,
+                                                  folder, share_info)
+                        elif g_miss:
+                            ok = self._recover_shared_seed(
+                                r, g_ids, g_recv, g_miss, folder)
+                        else:
+                            ok = True
+                        if not ok:
+                            unmask_failed = True
+                            break
             mean_delta, total_w, mean_loss = folder.mean()
             if skipped_quorum:
                 telemetry.get_registry().counter(
@@ -636,6 +912,12 @@ class FederatedCoordinator:
             rec["bytes_saved_uplink"] = (self._uplink_saved_per_update
                                          * folded)
             rec["uplink_densify_avoided"] = folder.densify_avoided
+        if tree_mode:
+            rec["aggregators"] = self.num_aggregators
+            if tree_stats["failovers"]:
+                # Conditional key (nonzero only): the agg chaos soak
+                # asserts on it, default tree records stay byte-stable.
+                rec["agg_failovers"] = tree_stats["failovers"]
         if self.accountant is not None:
             # Workers calibrate per-client noise to the NOMINAL cohort
             # (fed/setup.py finalize_client_delta), so with only ``folded``
@@ -657,22 +939,167 @@ class FederatedCoordinator:
             rec["dp_delta"] = self.accountant.delta
         return rec
 
-    def _share_phase(self, r: int, cohort, ctx):
+    def _tree_collect(self, r: int, slices, body, share_info, folder,
+                      timeout: float, secure: bool, stale: list,
+                      ctx=None) -> dict:
+        """Tree-mode collect: ONE fold request per cohort slice, routed
+        to its assigned aggregator (slice i → live aggregator i mod N).
+        Failover is slice-granular — a dead assignment (expired
+        heartbeat, refused connection, SIGKILL mid-fold) re-homes the
+        WHOLE slice to the next live sibling inside the round budget;
+        devices simply re-train on the relayed duplicate request, which
+        is deterministic, so the re-homed partial differs from the lost
+        one only by fold regrouping.  Only when no sibling survives does
+        the slice quorum-drop (``action="drop"``) — the weighted mean
+        renormalizes automatically.  Returns per-slice bookkeeping the
+        aggregate phase needs for group-local mask recovery."""
+        reg = telemetry.get_registry()
+        live = self._live_aggregators()
+        agg_order = sorted(self._aggs)
+        deadline = time.monotonic() + timeout
+        slice_ids = [sorted(int(d.device_id) for d in sl) for sl in slices]
+
+        def ask_slice(i: int, devs):
+            req = protocol.attach_trace({
+                "op": "fold", "round": r,
+                "devices": [[int(d.device_id), d.host, d.port]
+                            for d in devs],
+            }, ctx)
+            if secure:
+                req["cohort"] = slice_ids[i]
+            if share_info is not None:
+                inboxes = {d.device_id: share_info["to"][d.device_id]
+                           for d in devs
+                           if share_info["to"].get(d.device_id)}
+                if inboxes:
+                    req["shares_in"] = inboxes
+            assigned = agg_order[i % len(agg_order)] if agg_order else None
+            candidates = (([assigned] if assigned in live else [])
+                          + [a for a in live if a != assigned])
+            for agg_id in candidates:
+                info = self._aggs[agg_id]
+                # The tier's fan-out budget is whatever REMAINS of the
+                # round at THIS attempt — a re-home must not restart the
+                # clock.
+                req["timeout"] = max(1.0, deadline - time.monotonic())
+                try:
+                    # Fresh connection per attempt: slices re-homing onto
+                    # the same sibling must not interleave frames on a
+                    # shared socket.
+                    cli = TensorClient(info["host"], info["port"],
+                                       timeout=protocol.CONNECT_TIMEOUT,
+                                       ident=f"agg:{agg_id}")
+                except OSError:
+                    protocol.count_suppressed()   # dead agg: try next host
+                    continue
+                try:
+                    hdr, tree = cli.request(req, body=body, timeout=timeout,
+                                            retry=self.retry,
+                                            deadline=deadline)
+                    if hdr.get("status") != "ok":
+                        raise RuntimeError(
+                            f"agg {agg_id}: {hdr.get('error')}")
+                    return hdr["meta"], tree, agg_id != assigned
+                except (OSError, protocol.ConnectionClosed, TimeoutError,
+                        RuntimeError):
+                    protocol.count_suppressed()   # mid-fold death: next host
+                    continue
+                finally:
+                    cli.close()
+            raise RuntimeError(f"slice {i}: no live aggregator")
+
+        results: dict[int, tuple[dict, bool]] = {}
+        work = [(i, sl) for i, sl in enumerate(slices) if sl]
+        if work:
+            with cf.ThreadPoolExecutor(
+                    max_workers=len(work),
+                    thread_name_prefix="tree-collect") as pool:
+                futs = {pool.submit(ask_slice, i, sl): i for i, sl in work}
+                pending = dict(futs)
+
+                def take(fut, i):
+                    try:
+                        meta, tree, rehomed = fut.result()
+                    except Exception:   # slice dropped: charged below
+                        return
+                    results[i] = (meta, rehomed)
+                    # Partials fold under slice keys on the MAIN thread,
+                    # arrival order immaterial (finalize re-orders).
+                    folder.add_partial(
+                        f"slice:{i}", float(meta.get("total_w", 0.0)),
+                        tree, float(meta.get("loss_sum", 0.0)),
+                        count=len(meta.get("folded_ids") or []))
+
+                try:
+                    for fut in cf.as_completed(futs, timeout=timeout):
+                        take(fut, pending.pop(fut))
+                except cf.TimeoutError:     # colearn: noqa(CL003)
+                    pass
+                for fut, i in pending.items():
+                    if fut.done():
+                        take(fut, i)    # race-window reply: use it
+                    else:
+                        fut.cancel()
+
+        rehomes = drops = 0
+        received: list[int] = []
+        failed: list[str] = []
+        slice_recv: list[list[int]] = [[] for _ in slices]
+        for i, sl in enumerate(slices):
+            got = results.get(i)
+            if got is None:
+                if sl:
+                    drops += 1
+                    failed.extend(d.device_id for d in sl)
+                continue
+            meta, rehomed = got
+            if rehomed:
+                rehomes += 1
+            recv = [int(c) for c in meta.get("folded_ids") or []]
+            slice_recv[i] = recv
+            received.extend(recv)
+            failed.extend(str(f) for f in meta.get("failed") or [])
+            stale.extend(str(s) for s in meta.get("stale") or [])
+            # Tier-side fold/decompress time overlapped with stragglers —
+            # same accounting slot as the root's own streaming overlap.
+            folder.fold_s += float(meta.get("fold_s", 0.0))
+            folder.densify_avoided += int(meta.get("densify_avoided", 0))
+        if rehomes:
+            reg.counter("comm.agg_failovers_total",
+                        labels={"action": "rehome"}).inc(rehomes)
+        if drops:
+            reg.counter("comm.agg_failovers_total",
+                        labels={"action": "drop"}).inc(drops)
+        if self._uplink_saved_per_update and received:
+            reg.counter("comm.bytes_saved_uplink").inc(
+                self._uplink_saved_per_update * len(received))
+        return {"received": received, "failed": failed,
+                "slice_ids": slice_ids, "slice_received": slice_recv,
+                "failovers": rehomes + drops}
+
+    def _share_phase(self, r: int, cohort, ctx, cohort_of=None):
         """Collect every cohort member's encrypted recovery shares
         (privacy/dropout.py) under the SHARE deadline (a fraction of the
         round budget).  Returns ``(share_info, failed_devices)`` where
         ``share_info`` routes each ciphertext to its destination's train
         request and records each origin's reconstruction threshold and
         self-mask commitment.  The coordinator relays ciphertexts it
-        cannot read — honest-but-curious stays honest-but-blind."""
+        cannot read — honest-but-curious stays honest-but-blind.
+
+        ``cohort_of`` (tree mode) maps device_id → that device's
+        group-local pairing cohort (its aggregator slice); masks then
+        pair only within a slice, so each partial sum is a complete
+        group whose pair masks cancel internally."""
         cohort_ids = sorted(int(d.device_id) for d in cohort)
         reg = telemetry.get_registry()
 
         def ask(dev: DeviceInfo, deadline: float):
+            ids = (cohort_of.get(dev.device_id, cohort_ids)
+                   if cohort_of else cohort_ids)
             header, _ = self._request(
                 dev,
                 protocol.attach_trace(
-                    {"op": "share_setup", "round": r, "cohort": cohort_ids},
+                    {"op": "share_setup", "round": r, "cohort": ids},
                     ctx),
                 deadline=deadline,
             )

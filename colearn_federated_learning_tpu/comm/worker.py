@@ -136,6 +136,20 @@ class DeviceWorker:
             self._incoming_shares: dict = {}   # (round, origin) -> (s, b)
             self._revealed: dict = {}          # (round, origin) -> "s"|"b"
 
+        # Always-on identity keypair: the announced pubkey is the identity
+        # the coordinator's durable enrollment ledger binds this device_id
+        # to, and challenge-on-resume proves possession of the private
+        # half (ckpt/wal.py EnrollmentLedger).  In dh mode the secure-agg
+        # session keypair doubles as the identity; otherwise one is
+        # generated purely for identity — either way every announce now
+        # carries a key, so the ledger never records a keyless device.
+        if self._dh_mode:
+            self._id_priv, self._id_pub = self._dh_priv, self._dh_pub
+        else:
+            from colearn_federated_learning_tpu.comm import keyexchange
+
+            self._id_priv, self._id_pub = keyexchange.generate_keypair()
+
         ds = dataset or data_registry.get_dataset(c.data.dataset,
                                                   seed=c.run.seed)
         self._dataset = ds
@@ -188,6 +202,15 @@ class DeviceWorker:
         # compress; reset on resync/param-cache miss (a stale residual
         # belongs to an update the server never folded).
         self._uplink_residual: Optional[Any] = None
+        # Adaptive topk density (fed.topk_adaptive): per-round effective
+        # fraction steered off the residual norm trend, clipped to the
+        # config's [topk_min_fraction, topk_max_fraction] band.
+        self._topk_fraction = float(c.fed.topk_fraction)
+        if getattr(c.fed, "topk_adaptive", False):
+            self._topk_fraction = min(
+                float(c.fed.topk_max_fraction),
+                max(float(c.fed.topk_min_fraction), self._topk_fraction))
+        self._last_residual_norm: Optional[float] = None
 
     # ------------------------------------------------------------------
     @property
@@ -219,11 +242,9 @@ class DeviceWorker:
     def _announce(self, broker: BrokerClient) -> None:
         """Subscribe to our role topic BEFORE announcing (no race)."""
         broker.subscribe(enrollment.ROLE_TOPIC + str(self.client_id))
-        pubkey = ""
-        if self._dh_mode:
-            from colearn_federated_learning_tpu.comm import keyexchange
+        from colearn_federated_learning_tpu.comm import keyexchange
 
-            pubkey = keyexchange.encode_public(self._dh_pub)
+        pubkey = keyexchange.encode_public(self._id_pub)
         enrollment.announce(broker, enrollment.DeviceInfo(
             device_id=str(self.client_id),
             host=self.host, port=self.port,
@@ -348,11 +369,36 @@ class DeviceWorker:
             return self._eval(tree)
         if op == "self_eval":
             return self._self_eval(tree)
+        if op == "challenge":
+            return self._challenge(header)
         if op == "info":
             return ({"meta": {"client_id": self.client_id,
                               "num_examples": self.num_examples,
                               "num_steps": self._num_steps}}, None)
         return ({"status": "error", "error": f"unknown op {op!r}"}, None)
+
+    def _challenge(self, header: dict) -> tuple[dict, Any]:
+        """Challenge-on-resume (coordinator.verify_resumed_devices):
+        prove possession of the identity private key behind our announced
+        pubkey by tagging the coordinator's nonce under the fresh
+        ephemeral pairing it sent — sha256(DH(id_priv, eph_pub) ‖ nonce).
+        A replayed or forged announcement cannot answer: the tag needs
+        the private half the ledger's pubkey was derived from."""
+        import hashlib
+
+        from colearn_federated_learning_tpu.comm import keyexchange
+
+        try:
+            secret = keyexchange.shared_secret(
+                self._id_priv,
+                keyexchange.decode_public(str(header.get("pub", ""))))
+            tag = hashlib.sha256(
+                secret + bytes.fromhex(str(header.get("nonce", "")))
+            ).hexdigest()
+        except ValueError as e:
+            return ({"status": "error", "error": f"bad challenge: {e}"},
+                    None)
+        return ({"meta": {"client_id": self.client_id, "tag": tag}}, None)
 
     def _partner_row(self, round_idx: int, cohort: list):
         """This client's secure-agg pairing partners for the round —
@@ -573,8 +619,10 @@ class DeviceWorker:
                 # params (comm.resync_total) instead of this device
                 # training on garbage or silently dropping out.  The
                 # feedback residual belongs to an update that never made
-                # it into the fold — drop it with the stale base.
+                # it into the fold — drop it with the stale base (and the
+                # adaptive-topk trend, which tracked that residual).
                 self._uplink_residual = None
+                self._last_residual_norm = None
                 return ({"status": "resync",
                          "error": f"client {self.client_id} has no cached "
                                   f"base for round {round_idx} delta"},
@@ -662,16 +710,44 @@ class DeviceWorker:
                 wire, cmeta, self._uplink_residual = (
                     compression.feedback_compress(
                         delta_np, self._uplink_residual, fed.compress,
-                        topk_fraction=fed.topk_fraction))
+                        topk_fraction=self._topk_fraction))
+                norm = float(
+                    pytrees.tree_global_norm(self._uplink_residual))
                 telemetry.get_registry().gauge(
-                    "fed.uplink_residual_norm").set(float(
-                        pytrees.tree_global_norm(self._uplink_residual)))
+                    "fed.uplink_residual_norm").set(norm)
+                self._adapt_topk(norm)
             else:
                 wire, cmeta = compression.compress_delta(
                     delta_np, fed.compress,
                     topk_fraction=fed.topk_fraction)
         meta.update(cmeta)
         return ({"meta": meta}, wire)
+
+    def _adapt_topk(self, norm: float) -> None:
+        """Adaptive per-round topk density (fed.topk_adaptive): when the
+        error-feedback residual norm GROWS round-over-round the codec is
+        dropping signal faster than feedback re-injects it — widen the
+        frame (×1.25); when it shrinks, the density is more than the
+        delta needs — tighten (×0.9, gentler so density decays only under
+        sustained slack).  Clipped to the configured
+        [topk_min_fraction, topk_max_fraction] band; the effective
+        fraction is exported on ``fed.topk_fraction_effective``."""
+        if not getattr(self.config.fed, "topk_adaptive", False):
+            return
+        from colearn_federated_learning_tpu import telemetry
+
+        fed = self.config.fed
+        prev, self._last_residual_norm = self._last_residual_norm, norm
+        if prev is not None:
+            if norm > prev:
+                self._topk_fraction *= 1.25
+            elif norm < prev:
+                self._topk_fraction *= 0.9
+        self._topk_fraction = min(
+            float(fed.topk_max_fraction),
+            max(float(fed.topk_min_fraction), self._topk_fraction))
+        telemetry.get_registry().gauge(
+            "fed.topk_fraction_effective").set(self._topk_fraction)
 
     def _unmask(self, round_idx: int, dropped: list, cohort: list,
                 _tree: Any) -> tuple[dict, Any]:

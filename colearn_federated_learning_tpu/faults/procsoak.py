@@ -43,16 +43,19 @@ _CLI = "colearn_federated_learning_tpu.cli"
 class KillSpec:
     """One scheduled SIGKILL.
 
-    ``target`` is ``"coordinator"``, ``"broker"`` or
-    ``"worker:<client_id>"``.  The signal is sent as soon as the round
-    record for ``after_round`` appears, i.e. it lands mid-round
-    ``after_round + 1``.  ``restart`` respawns the victim: a worker
-    re-announces on a fresh port (and is re-admitted by the elastic
-    coordinator after eviction), the coordinator comes back with
-    ``--resume``, and the broker rebinds its ORIGINAL port — the
-    control-plane SPOF heals through the worker re-enrollment watchdog
-    and the coordinator's ``_rebuild_broker`` without any address
-    change."""
+    ``target`` is ``"coordinator"``, ``"broker"``,
+    ``"worker:<client_id>"`` or ``"aggregator:<n>"``.  The signal is
+    sent as soon as the round record for ``after_round`` appears, i.e.
+    it lands mid-round ``after_round + 1``.  ``restart`` respawns the
+    victim: a worker re-announces on a fresh port (and is re-admitted
+    by the elastic coordinator after eviction), the coordinator comes
+    back with ``--resume``, and the broker rebinds its ORIGINAL port —
+    the control-plane SPOF heals through the worker re-enrollment
+    watchdog and the coordinator's ``_rebuild_broker`` without any
+    address change.  An aggregator is the one role that may STAY dead
+    (``restart=False``): the root must re-home its slice onto a
+    sibling or quorum-drop it — that failover IS the thing the agg
+    soak gates on."""
 
     target: str
     after_round: int
@@ -60,11 +63,12 @@ class KillSpec:
 
     def __post_init__(self):
         if self.target not in ("coordinator", "broker") and not (
-                self.target.startswith("worker:")
+                self.target.split(":", 1)[0] in ("worker", "aggregator")
+                and ":" in self.target
                 and self.target.split(":", 1)[1].isdigit()):
             raise ValueError(
-                f"target must be 'coordinator', 'broker' or "
-                f"'worker:<id>', got {self.target!r}")
+                f"target must be 'coordinator', 'broker', "
+                f"'worker:<id>' or 'aggregator:<n>', got {self.target!r}")
         if self.after_round < 0:
             raise ValueError(
                 f"after_round must be >= 0, got {self.after_round}")
@@ -133,6 +137,7 @@ class _Fleet:
         self.env = env
         self.broker: Optional[subprocess.Popen] = None
         self.workers: dict[int, subprocess.Popen] = {}
+        self.aggregators: dict[int, subprocess.Popen] = {}
         self.coord: Optional[subprocess.Popen] = None
         self._logs: list = []
 
@@ -199,6 +204,14 @@ class _Fleet:
              "--broker-host", host, "--broker-port", str(port)],
             stdout=log, stderr=log)
 
+    def start_aggregator(self, agg_id: int, cfg: list[str], host: str,
+                         port: int) -> None:
+        log = self._log_file(f"aggregator{agg_id}.log")
+        self.aggregators[agg_id] = self.spawn(
+            ["aggregator", *cfg, "--agg-id", str(agg_id),
+             "--broker-host", host, "--broker-port", str(port)],
+            stdout=log, stderr=log)
+
     def start_coordinator(self, cfg: list[str], host: str, port: int,
                           n_workers: int, round_timeout: float,
                           enroll_timeout: float,
@@ -216,14 +229,18 @@ class _Fleet:
             stderr=subprocess.PIPE, text=True)
         return self.coord
 
+    def _all_procs(self) -> list:
+        return ([self.coord, self.broker] + list(self.workers.values())
+                + list(self.aggregators.values()))
+
     def kill_all(self) -> None:
-        for p in ([self.coord, self.broker] + list(self.workers.values())):
+        for p in self._all_procs():
             if p is not None and p.poll() is None:
                 p.kill()
 
     def close(self) -> None:
         self.kill_all()
-        for p in ([self.coord, self.broker] + list(self.workers.values())):
+        for p in self._all_procs():
             if p is not None:
                 p.wait()
         for f in self._logs:
@@ -239,6 +256,7 @@ def run_proc_soak(
     enroll_timeout: float = 90.0,
     timeout_s: float = 600.0,
     seed: int = 0,
+    n_aggregators: int = 0,
     log_fn: Optional[Callable[[dict], None]] = None,
 ) -> dict:
     """Run one multi-process soak and return its summary.
@@ -262,6 +280,11 @@ def run_proc_soak(
             if not 0 <= wid < n_workers:
                 raise ValueError(f"{k.target} out of range "
                                  f"[0, {n_workers})")
+        elif k.target.startswith("aggregator:"):
+            aid = int(k.target.split(":", 1)[1])
+            if not 0 <= aid < n_aggregators:
+                raise ValueError(f"{k.target} out of range "
+                                 f"[0, {n_aggregators})")
     workdir = workdir or tempfile.mkdtemp(prefix="colearn_mpsoak_")
     os.makedirs(workdir, exist_ok=True)
     ckpt_dir = os.path.join(workdir, "ckpt")
@@ -299,8 +322,16 @@ def run_proc_soak(
         worker_cfg = _config_flags(rounds, n_workers, seed) + flight_flags
         for i in range(n_workers):
             fleet.start_worker(i, worker_cfg, host, port)
+        # Aggregator tier (tree ingest): spawned between broker and
+        # coordinator so the retained announcements are on the broker
+        # before the root's enroll_aggregators() subscribes.
+        agg_cfg = worker_cfg
+        for a in range(n_aggregators):
+            fleet.start_aggregator(a, agg_cfg, host, port)
         coord_cfg = _config_flags(rounds, n_workers, seed,
                                   checkpoint_dir=ckpt_dir) + flight_flags
+        if n_aggregators:
+            coord_cfg += ["--num-aggregators", str(n_aggregators)]
 
         def launch(resume: bool) -> subprocess.Popen:
             return fleet.start_coordinator(
@@ -359,6 +390,15 @@ def run_proc_soak(
                         victim.send_signal(signal.SIGKILL)
                         victim.wait()
                     fleet.restart_broker()
+                elif spec.target.startswith("aggregator:"):
+                    aid = int(spec.target.split(":", 1)[1])
+                    victim = fleet.aggregators.get(aid)
+                    if victim is not None and victim.poll() is None:
+                        kill_rec["pid"] = victim.pid
+                        victim.send_signal(signal.SIGKILL)
+                        victim.wait()
+                    if spec.restart:
+                        fleet.start_aggregator(aid, agg_cfg, host, port)
                 else:
                     wid = int(spec.target.split(":", 1)[1])
                     victim = fleet.workers.get(wid)
@@ -404,10 +444,145 @@ def run_proc_soak(
         "per_client_acc": per_client.get("per_client", {}),
         "rounds_resumed": resumed,
         "coordinator_incarnations": incarnations,
+        "agg_failovers": sum(int(r.get("agg_failovers", 0)) for r in recs),
         "kills": delivered,
         "flight_dumps": len(dumped_pids),
         "flight_missing": flight_missing,
         "events": events,
         "exit_code": rc,
+        "workdir": workdir,
+    }
+
+
+def _final_checkpoint_state(ckpt_dir: str):
+    """Load the server state from the LATEST checkpoint under
+    ``ckpt_dir`` without a target template (the harness has no model —
+    the saved metadata carries the tree structure and dtypes)."""
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    try:
+        step = mgr.latest_step()
+        if step is None:
+            return None, None
+        restored = mgr.restore(
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore()))
+        return restored["state"], step
+    finally:
+        mgr.close()
+
+
+def _max_param_diff(state_a, state_b) -> float:
+    """Max abs elementwise difference across two server-state pytrees
+    (leaf-path aligned; a structure mismatch is itself a failure and
+    surfaces as ``inf``)."""
+    import jax
+    import numpy as np
+
+    la, ta = jax.tree_util.tree_flatten_with_path(state_a)
+    lb, tb = jax.tree_util.tree_flatten_with_path(state_b)
+    if ta != tb or [p for p, _ in la] != [p for p, _ in lb]:
+        return float("inf")
+    worst = 0.0
+    for (_, a), (_, b) in zip(la, lb):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.shape != b.shape:
+            return float("inf")
+        if a.size:
+            worst = max(worst, float(np.max(np.abs(
+                a.astype(np.float64) - b.astype(np.float64)))))
+    return worst
+
+
+def run_agg_soak(
+    rounds: int = 4,
+    n_workers: int = 3,
+    workdir: Optional[str] = None,
+    round_timeout: float = 120.0,
+    enroll_timeout: float = 90.0,
+    timeout_s: float = 600.0,
+    kill: bool = True,
+    seed: int = 0,
+    tol: float = 2e-4,
+    log_fn: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """Aggregator-tree chaos gate: tree soak under a real aggregator
+    SIGKILL, lockstep against a flat (no-tree) oracle.
+
+    Two full subprocess federations with identical config and seed:
+
+    - **tree** — 2 aggregator processes own the device slices; with
+      ``kill=True`` aggregator 0 is SIGKILLed mid-round (and stays
+      dead), so the root must re-home its slice onto aggregator 1 or
+      quorum-drop it (``agg_failovers >= 1`` in the round records);
+    - **oracle** — the same federation folding flat at the root, no
+      kills.
+
+    The gate then compares the FINAL checkpointed server state of both
+    runs: re-homing must lose no contribution, so the tree run's params
+    stay within ``tol`` of the oracle's (the slack covers fold-order
+    float non-associativity between arrival-order flat folds and
+    slice-blocked tree folds, same bound as the secure-soak gate).  The
+    killed aggregator must also have left a parseable flight dump whose
+    postmortem attributes the death to the aggregator role."""
+    workdir = workdir or tempfile.mkdtemp(prefix="colearn_aggsoak_")
+    os.makedirs(workdir, exist_ok=True)
+    kills = ([KillSpec("aggregator:0",
+                       after_round=max(0, rounds // 2 - 1),
+                       restart=False)]
+             if kill else [])
+
+    tree = run_proc_soak(
+        rounds=rounds, n_workers=n_workers, kills=kills,
+        workdir=os.path.join(workdir, "tree"),
+        round_timeout=round_timeout, enroll_timeout=enroll_timeout,
+        timeout_s=timeout_s, seed=seed, n_aggregators=2, log_fn=log_fn)
+    oracle = run_proc_soak(
+        rounds=rounds, n_workers=n_workers, kills=[],
+        workdir=os.path.join(workdir, "flat"),
+        round_timeout=round_timeout, enroll_timeout=enroll_timeout,
+        timeout_s=timeout_s, seed=seed, n_aggregators=0, log_fn=log_fn)
+
+    state_t, step_t = _final_checkpoint_state(
+        os.path.join(workdir, "tree", "ckpt"))
+    state_o, step_o = _final_checkpoint_state(
+        os.path.join(workdir, "flat", "ckpt"))
+    if state_t is None or state_o is None or step_t != step_o:
+        max_diff = float("inf")
+    else:
+        max_diff = _max_param_diff(state_t, state_o)
+    oracle_ok = max_diff <= tol
+
+    # Postmortem attribution: the killed aggregator's black box must be
+    # in the tree run's flight ledger AND the merged report must name
+    # the victim as an aggregator — the same artifact `colearn
+    # postmortem --flight-dir <workdir>/tree/flight` shows an operator.
+    from colearn_federated_learning_tpu.telemetry import flight as _flight
+
+    killed_pids = {k["pid"] for k in tree["kills"] if "pid" in k}
+    if killed_pids:
+        dumps = _flight.load_flight_dumps(
+            os.path.join(workdir, "tree", "flight"))
+        report = _flight.postmortem_report(dumps)
+        attributed = any(
+            p.get("pid") in killed_pids
+            and str(p.get("role", "")).startswith("aggregator")
+            for p in report.get("processes", []))
+    else:
+        attributed = not kill
+
+    return {
+        "exit_code": tree["exit_code"],
+        "oracle_exit_code": oracle["exit_code"],
+        "rounds_run": tree["rounds_run"],
+        "oracle_rounds_run": oracle["rounds_run"],
+        "oracle_ok": oracle_ok,
+        "max_param_diff": max_diff,
+        "checkpoint_step": step_t,
+        "agg_failovers": tree["agg_failovers"],
+        "postmortem_attributed": attributed,
+        "flight_missing": tree["flight_missing"],
+        "kills": tree["kills"],
+        "records": tree["records"],
         "workdir": workdir,
     }

@@ -47,6 +47,31 @@ def init_global_model(config: ExperimentConfig, path: str) -> None:
                            meta={"round": 0, "config": config.run.name})
 
 
+def _load_residual(residual_path: str, round_idx: int):
+    """Load the carried error-feedback residual for ``round_idx``.
+
+    The residual file is only valid when it was produced at the
+    IMMEDIATELY preceding round: a gap means the silo's last update was
+    rejected (stale/torn at the aggregator) or rounds were skipped, and
+    re-injecting that residual would smuggle a stale gradient into the
+    new global model.  Invalid carries reset to None and are counted on
+    ``fed.offline_residual_resets_total`` by reason."""
+    reg = _metrics.get_registry()
+    try:
+        prev, rmeta = load_pytree_npz(residual_path)
+    except FileNotFoundError:
+        return None                    # first round: nothing carried yet
+    except _BAD_UPDATE_ERRORS:
+        reg.counter("fed.offline_residual_resets_total",
+                    labels={"reason": "torn"}).inc()
+        return None
+    if int(rmeta.get("round", -1)) != round_idx - 1:
+        reg.counter("fed.offline_residual_resets_total",
+                    labels={"reason": "stale"}).inc()
+        return None
+    return prev
+
+
 def client_update(
     config: ExperimentConfig,
     client_id: int,
@@ -54,10 +79,22 @@ def client_update(
     out_path: str,
     round_idx: int = 0,
     dataset: Optional[data_registry.Dataset] = None,
+    residual_path: Optional[str] = None,
 ) -> dict:
     """One silo's local round: load global params, train on the silo's
-    partition, write a weighted delta update file.  Returns summary stats."""
+    partition, write a weighted delta update file.  Returns summary stats.
+
+    ``residual_path`` carries uplink error feedback across file-plane
+    rounds (``fed.compress_feedback``): the compression residual is
+    persisted next to the silo's state and folded into the next round's
+    delta — the same EF-SGD loop the socket worker runs in memory."""
     c = config
+    # Same rejection rule as the wire plane (comm/worker.py): a masked
+    # update cannot carry a plaintext compression residual.
+    if c.fed.secure_agg and c.fed.compress_feedback:
+        raise ValueError(
+            "secure_agg cannot carry uplink error feedback: masked "
+            "updates leave no plaintext compression residual to feed back")
     setup_lib.require_stateless_strategy(c, "the file-based client flow")
     params, meta = load_pytree_npz(global_path)
     round_idx = int(meta.get("round", round_idx))
@@ -98,9 +135,31 @@ def client_update(
 
     from colearn_federated_learning_tpu.fed import compression
 
-    wire, cmeta = compression.compress_delta(
-        jax.tree.map(np.asarray, delta), c.fed.compress
-    )
+    delta_np = jax.tree.map(np.asarray, delta)
+    feedback = (c.fed.compress_feedback and residual_path is not None
+                and c.fed.compress != "none")
+    if feedback:
+        residual = _load_residual(residual_path, round_idx)
+        try:
+            wire, cmeta, new_residual = compression.feedback_compress(
+                delta_np, residual, c.fed.compress,
+                topk_fraction=c.fed.topk_fraction)
+        except ValueError:
+            # Carried tree no longer matches the model (config changed
+            # between rounds): reset and compress uncompensated.
+            _metrics.get_registry().counter(
+                "fed.offline_residual_resets_total",
+                labels={"reason": "shape"}).inc()
+            wire, cmeta, new_residual = compression.feedback_compress(
+                delta_np, None, c.fed.compress,
+                topk_fraction=c.fed.topk_fraction)
+        if new_residual is not None:
+            atomic_save_pytree_npz(
+                residual_path, new_residual,
+                meta={"round": round_idx, "client_id": client_id})
+    else:
+        wire, cmeta = compression.compress_delta(
+            delta_np, c.fed.compress, topk_fraction=c.fed.topk_fraction)
     umeta = fileplane.stale_meta(
         {"round": round_idx, "weight": weight, "client_id": client_id,
          "num_examples": int(result.num_examples),

@@ -90,6 +90,28 @@ def validate_robustness(config: "ExperimentConfig") -> None:
             "pairwise mask cancellation), so there is no compression "
             "residual to feed back"
         )
+    if fed.topk_adaptive:
+        if fed.compress != "topk" or not fed.compress_feedback:
+            raise ValueError(
+                "topk_adaptive steers density off the error-feedback "
+                "residual norm, so it needs compress='topk' AND "
+                "compress_feedback=True"
+            )
+        if not (0.0 < fed.topk_min_fraction
+                <= fed.topk_max_fraction <= 1.0):
+            raise ValueError(
+                "topk_adaptive needs 0 < topk_min_fraction <= "
+                "topk_max_fraction <= 1, got "
+                f"[{fed.topk_min_fraction}, {fed.topk_max_fraction}]"
+            )
+    if run.num_aggregators < 0:
+        raise ValueError(
+            f"num_aggregators must be >= 0, got {run.num_aggregators}")
+    if run.num_aggregators and run.agg_heartbeat_timeout <= 0:
+        raise ValueError(
+            "agg_heartbeat_timeout must be positive, got "
+            f"{run.agg_heartbeat_timeout}"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,6 +239,15 @@ class FedConfig:
     # UPLINK codec.  Feedback de-biases sparsification, which makes the
     # density a real accuracy/bytes knob rather than a fixed bias cap.
     topk_fraction: float = 0.05
+    # Adaptive per-round topk density (comm/worker.py _adapt_topk): each
+    # worker steers its effective fraction off the round-over-round trend
+    # of its error-feedback residual norm (growing residual → widen,
+    # shrinking → tighten), clipped to [topk_min_fraction,
+    # topk_max_fraction].  Requires compress="topk" + compress_feedback
+    # (the controller's signal IS the feedback residual).
+    topk_adaptive: bool = False
+    topk_min_fraction: float = 0.01
+    topk_max_fraction: float = 0.25
     # DOWNLINK compression (synchronous coordinator broadcast): ship the
     # server delta through the same codecs against a worker-side param
     # cache (comm/downlink.py).  "none" keeps the broadcast byte-identical
@@ -252,6 +283,14 @@ class RunConfig:
     comm_retries: int = 2              # transient-failure retries per request
     comm_backoff_base: float = 0.05    # full-jitter backoff base (s)
     comm_backoff_max: float = 2.0      # backoff cap (s)
+    # Aggregator tree (comm/aggregator.py): N real aggregator processes
+    # each fold one cohort slice and ship one partial sum to the root.
+    # 0 = flat federation (every uplink byte lands on the coordinator).
+    num_aggregators: int = 0
+    # Bounded-deadline failure detection: an aggregator whose retained
+    # heartbeat is older than this is treated as dead at dispatch and its
+    # slices re-home to live siblings.
+    agg_heartbeat_timeout: float = 5.0
     # Deterministic fault injection (faults/): path to a FaultPlan JSON
     # installed as the transport interposer; None = no fault layer at all.
     fault_plan: Optional[str] = None

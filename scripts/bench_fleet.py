@@ -108,10 +108,39 @@ UPLINK_ROW_SCHEMA = {
     "bench_wall_s": float,
 }
 
+# Aggregator-tree ingest rows (--ingest-sweep): the root's per-round
+# ingest bill and fold critical path at fleet scale, swept over the
+# aggregator count N.  Bytes are analytic shape-only wire pricing
+# (comm/aggregator.expected_ingest); the per-update fold cost is
+# MEASURED on this host with the real StreamingFolder, then scaled —
+# each aggregator folds ceil(C/N) updates in parallel while the root
+# folds only N partials, so the critical path drops ~1/N.
+INGEST_ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "aggregators": int,
+    "param_count": int,
+    "update_bytes": int,
+    "partial_bytes": int,
+    "agg_ingest_bytes": int,
+    "root_ingest_bytes": int,
+    "flat_root_ingest_bytes": int,
+    "root_ingest_reduction_x": float,
+    "ingest_scale_x": float,
+    "fold_s_per_update": float,
+    "agg_fold_s_est": float,
+    "root_fold_s_est": float,
+    "critical_path_fold_s_est": float,
+    "flat_fold_s_est": float,
+    "fold_speedup_x": float,
+    "bench_wall_s": float,
+}
+
 SCHEMAS = {
     "fleet_round": ROW_SCHEMA,
     "fleet_mask_cost": MASK_ROW_SCHEMA,
     "fleet_uplink_bytes": UPLINK_ROW_SCHEMA,
+    "fleet_ingest_scaling": INGEST_ROW_SCHEMA,
 }
 
 
@@ -260,6 +289,87 @@ def uplink_point(devices: int, scheme: str, topk_fraction: float,
     }
 
 
+def measured_fold_s_per_update(params, folds: int = 64) -> float:
+    """Median-free per-update fold cost, measured with the REAL
+    StreamingFolder (dense add + finalize, amortized) on this host.
+    The tree never changes the per-update work — it changes WHERE it
+    runs — so one measured constant prices every sweep row."""
+    import jax
+    import numpy as np
+
+    from colearn_federated_learning_tpu.comm.aggregation import (
+        StreamingFolder,
+    )
+
+    shapes = jax.tree.map(np.asarray, params)
+    update = jax.tree.map(
+        lambda p: np.ones(np.shape(p), np.float32), params)
+    folder = StreamingFolder(shapes)
+    t0 = time.perf_counter()
+    for i in range(folds):
+        folder.add({"client_id": str(i), "weight": 1.0, "train_loss": 0.0},
+                   update)
+    folder.finalize()
+    return (time.perf_counter() - t0) / folds
+
+
+def ingest_point(devices: int, n_aggregators: int, params,
+                 fold_s_per_update: float) -> dict:
+    """One aggregator-tree ingest row at ``devices`` cohort and
+    ``n_aggregators`` fan-in: analytic wire bytes per tier plus the
+    fold critical path derived from the measured per-update cost."""
+    import jax
+    import math
+    import numpy as np
+
+    from colearn_federated_learning_tpu.comm import aggregator
+    from colearn_federated_learning_tpu.utils.serialization import (
+        wire_frame_length,
+    )
+
+    t0 = time.time()
+    zeros = jax.tree.map(
+        lambda p: np.zeros(np.shape(p), np.float32), params)
+    update_bytes = int(wire_frame_length(
+        zeros, {"round": 0, "op": "train", "compress": "none"}))
+    # A partial sum is one dense tree regardless of slice size — the
+    # whole point of the tree: root ingest is N frames, not C.
+    partial_bytes = int(wire_frame_length(
+        zeros, {"round": 0, "op": "fold", "agg_id": 0}))
+    bill = aggregator.expected_ingest(devices, n_aggregators,
+                                      update_bytes, partial_bytes)
+    per_agg = math.ceil(devices / max(1, n_aggregators))
+    agg_fold = per_agg * fold_s_per_update
+    root_fold = n_aggregators * fold_s_per_update
+    flat_fold = devices * fold_s_per_update
+    critical = agg_fold + root_fold
+    return {
+        "bench": "fleet_ingest_scaling",
+        "devices": devices,
+        "aggregators": n_aggregators,
+        "param_count": int(sum(np.asarray(p).size
+                               for p in jax.tree.leaves(params))),
+        "update_bytes": update_bytes,
+        "partial_bytes": partial_bytes,
+        "agg_ingest_bytes": bill["agg_ingest_bytes"],
+        "root_ingest_bytes": bill["root_ingest_bytes"],
+        "flat_root_ingest_bytes": bill["flat_root_ingest_bytes"],
+        "root_ingest_reduction_x": round(
+            bill["flat_root_ingest_bytes"]
+            / max(1, bill["root_ingest_bytes"]), 2),
+        "ingest_scale_x": round(
+            bill["flat_root_ingest_bytes"]
+            / max(1, bill["agg_ingest_bytes"]), 2),
+        "fold_s_per_update": round(fold_s_per_update, 9),
+        "agg_fold_s_est": round(agg_fold, 4),
+        "root_fold_s_est": round(root_fold, 4),
+        "critical_path_fold_s_est": round(critical, 4),
+        "flat_fold_s_est": round(flat_fold, 4),
+        "fold_speedup_x": round(flat_fold / critical, 2),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
 def mask_point(devices: int, neighbors: int, group_size: int,
                param_count: int) -> dict:
     """One masked-uplink cost row: per-device PRG FLOPs + recovery-share
@@ -361,6 +471,15 @@ def main(argv=None) -> int:
     ap.add_argument("--uplink-topk-fraction", type=float, default=0.05,
                     help="topk density for the uplink sweep "
                          "(FedConfig.topk_fraction default)")
+    ap.add_argument("--ingest-sweep", action="store_true",
+                    help="append fleet_ingest_scaling rows: root ingest "
+                         "bytes + fold critical path at --ingest-devices "
+                         "swept over --ingest-aggregators (analytic wire "
+                         "pricing x measured StreamingFolder cost)")
+    ap.add_argument("--ingest-devices", type=int, default=1_000_000,
+                    help="cohort size for the ingest-scaling sweep")
+    ap.add_argument("--ingest-aggregators", default="1,2,4",
+                    help="comma-separated aggregator counts N to sweep")
     ap.add_argument("--append", action="store_true",
                     help="append rows to --out instead of rewriting it "
                          "(e.g. --cohorts '' --mask-sweep --append adds "
@@ -384,6 +503,13 @@ def main(argv=None) -> int:
         for scheme in (s for s in args.uplink_schemes.split(",") if s):
             row = uplink_point(args.uplink_devices, scheme,
                                args.uplink_topk_fraction, params)
+            rows.append(row)
+            print(json.dumps(row))
+    if args.ingest_sweep:
+        params = bench_params(args.seed)
+        fold_s = measured_fold_s_per_update(params)
+        for n in (int(x) for x in args.ingest_aggregators.split(",") if x):
+            row = ingest_point(args.ingest_devices, n, params, fold_s)
             rows.append(row)
             print(json.dumps(row))
 

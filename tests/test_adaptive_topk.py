@@ -1,0 +1,106 @@
+"""Adaptive per-round topk density (comm/worker._adapt_topk): the
+effective fraction is steered off the error-feedback residual-norm trend
+within the configured band, validated up front, and a topk_adaptive
+federation tracks the fixed-density baseline."""
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.comm.broker import MessageBroker
+from colearn_federated_learning_tpu.comm.coordinator import FederatedCoordinator
+from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+from colearn_federated_learning_tpu.utils.config import validate_robustness
+
+from tests.test_comm import _config
+
+
+def _adaptive_cfg(**kw):
+    fed = dict(compress="topk", compress_feedback=True, topk_adaptive=True,
+               topk_fraction=0.05, topk_min_fraction=0.02,
+               topk_max_fraction=0.1)
+    fed.update(kw)
+    return _config(num_clients=3, **fed)
+
+
+# --------------------------------------------------------------- policy ----
+def test_adapt_widens_on_rising_norm_and_tightens_on_falling():
+    w = DeviceWorker(_adaptive_cfg(), 0)
+    assert w._topk_fraction == pytest.approx(0.05)
+    w._adapt_topk(1.0)                   # first norm: no trend yet
+    assert w._topk_fraction == pytest.approx(0.05)
+    w._adapt_topk(2.0)                   # rising: codec is dropping signal
+    assert w._topk_fraction == pytest.approx(0.05 * 1.25)
+    w._adapt_topk(1.5)                   # falling: density has slack
+    assert w._topk_fraction == pytest.approx(0.05 * 1.25 * 0.9)
+
+
+def test_adapt_clips_to_configured_band():
+    w = DeviceWorker(_adaptive_cfg(), 0)
+    norms = iter(range(1, 40))
+    w._adapt_topk(next(norms))
+    for n in norms:                      # monotone rising: grow to the cap
+        w._adapt_topk(n)
+    assert w._topk_fraction == pytest.approx(0.1)
+    for n in range(40, 1, -1):           # monotone falling: shrink to floor
+        w._adapt_topk(n)
+    assert w._topk_fraction == pytest.approx(0.02)
+    assert telemetry.get_registry().gauge(
+        "fed.topk_fraction_effective").value == pytest.approx(0.02)
+
+
+def test_adaptive_disabled_never_moves():
+    w = DeviceWorker(_config(num_clients=3, compress="topk",
+                             compress_feedback=True), 0)
+    for n in (1.0, 5.0, 25.0):
+        w._adapt_topk(n)
+    assert w._topk_fraction == pytest.approx(0.05)
+
+
+# ----------------------------------------------------------- validation ----
+def test_validation_rejects_unsound_adaptive_configs():
+    with pytest.raises(ValueError, match="topk_adaptive"):
+        validate_robustness(_config(num_clients=3, topk_adaptive=True))
+    with pytest.raises(ValueError, match="topk_adaptive"):
+        validate_robustness(_config(num_clients=3, compress="topk",
+                                    topk_adaptive=True))
+    with pytest.raises(ValueError, match="topk_min_fraction"):
+        validate_robustness(_adaptive_cfg(topk_min_fraction=0.3,
+                                          topk_max_fraction=0.1))
+    validate_robustness(_adaptive_cfg())     # sound config passes
+
+
+# ---------------------------------------------------------- convergence ----
+def _run(cfg, rounds=4):
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(3)]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0)
+            coord.enroll(min_devices=3, timeout=20.0)
+            hist = coord.fit(rounds=rounds)
+            acc = coord.evaluate()["eval_acc"]
+            coord.close()
+            return hist, acc
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_adaptive_federation_tracks_fixed_density():
+    fixed = _config(num_clients=3, compress="topk", compress_feedback=True,
+                    topk_fraction=0.05)
+    h_fix, acc_fix = _run(fixed, rounds=6)
+    # Band floor at the fixed baseline's density: the comparison isolates
+    # the STEERING (can it widen/settle without hurting convergence),
+    # not a thinner wire budget.
+    h_ad, acc_ad = _run(_adaptive_cfg(topk_min_fraction=0.05,
+                                      topk_max_fraction=0.2), rounds=6)
+    assert all(r["completed"] == 2 for r in h_ad)
+    assert np.isfinite(h_ad[-1]["train_loss"])
+    # Density steering must not cost convergence on the smoke problem.
+    assert acc_ad >= acc_fix - 0.1, (acc_ad, acc_fix)
+    eff = telemetry.get_registry().gauge("fed.topk_fraction_effective").value
+    assert 0.05 <= eff <= 0.2

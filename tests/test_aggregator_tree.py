@@ -1,0 +1,266 @@
+"""Aggregator tree (comm/aggregator.py): slice layout, the partial
+combine's bitwise parity against the slice-blocked flat fold, the
+analytic ingest bill, and full tree federations — dense and topk parity
+with the flat coordinator, failover re-home on a killed aggregator, and
+secure-agg composition over slice-local mask groups."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.comm.aggregation import StreamingFolder
+from colearn_federated_learning_tpu.comm.aggregator import (
+    AggregatorServer,
+    combine_partial_weights,
+    expected_ingest,
+    slice_cohort,
+)
+from colearn_federated_learning_tpu.comm.broker import MessageBroker
+from colearn_federated_learning_tpu.comm.coordinator import FederatedCoordinator
+from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+from colearn_federated_learning_tpu.parallel import partition
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+from tests.test_uplink_fastpath import _params, _topk_updates, _tree_bytes
+
+
+# ------------------------------------------------------------- slicing ----
+def test_slice_cohort_contiguous_and_balanced():
+    cohort = [str(i) for i in range(10)]
+    for n in (1, 2, 3, 4, 7, 10, 13):
+        slices = slice_cohort(cohort, n)
+        assert len(slices) == n
+        # Contiguous: concatenation reproduces the cohort order exactly.
+        assert [c for sl in slices for c in sl] == cohort
+        sizes = [len(sl) for sl in slices]
+        assert max(sizes) - min(sizes) <= 1
+    assert slice_cohort([], 3) == [[], [], []]
+    assert slice_cohort(cohort, 0) == [cohort]      # clamped to 1
+
+
+def test_expected_ingest_bill():
+    bill = expected_ingest(cohort=10, n_aggregators=4, update_bytes=100,
+                           partial_bytes=700)
+    assert bill["agg_ingest_bytes"] == 3 * 100       # ceil(10/4) frames
+    assert bill["root_ingest_bytes"] == 4 * 700
+    assert bill["flat_root_ingest_bytes"] == 10 * 100
+
+
+def test_combine_partial_weights_is_sequential_float_sum():
+    ws = [0.1, 0.7, 1e-8, 3.0]
+    acc = 0.0
+    for w in ws:
+        acc += w
+    assert combine_partial_weights(ws) == acc
+
+
+# ----------------------------------------------- partial-combine parity ----
+def _tree_fold(shapes, layout, updates, placement=None):
+    """Simulate the tree: one folder per slice (what an AggregatorServer
+    runs), then a root folder combining the partials in slice order."""
+    staged = {m["client_id"]: (m, w) for m, w, _ in updates}
+    root = StreamingFolder(shapes, order=[f"agg:{i}"
+                                          for i in range(len(layout))],
+                           placement=placement)
+    for i, sl in enumerate(layout):
+        leaf = StreamingFolder(shapes, order=list(sl))
+        for cid in sl:
+            if cid in staged:
+                meta, wire = staged[cid]
+                leaf.add(dict(meta), jax.tree.map(np.copy, wire))
+        leaf.finalize()
+        root.add_partial(f"agg:{i}", leaf.total_w, leaf.wsum,
+                         leaf.loss_sum, count=leaf.count)
+    root.finalize()
+    return root
+
+
+@pytest.mark.parametrize("present", [5, 3])  # full cohort / partial cohort
+@pytest.mark.parametrize("scheme", ["dense", "topk"])
+def test_partial_combine_bitwise_vs_slice_blocked_flat(scheme, present):
+    shapes = _params()
+    updates = [(m, w, d) for m, w, d in _topk_updates(5)][:present]
+    if scheme == "dense":
+        updates = [({k: v for k, v in m.items() if k != "compress"}, d, d)
+                   for m, _, d in updates]
+    order = [str(i) for i in range(5)]
+    layout = slice_cohort(order, 2)
+
+    flat = StreamingFolder(shapes, order=order, slices=layout)
+    arrival = list(updates)
+    random.Random(11).shuffle(arrival)       # arrival order must not matter
+    for meta, wire, _ in arrival:
+        flat.add(dict(meta), jax.tree.map(np.copy, wire))
+    flat.finalize()
+
+    tree = _tree_fold(shapes, layout, updates)
+    assert tree.total_w == flat.total_w
+    assert tree.loss_sum == flat.loss_sum
+    assert _tree_bytes(tree.wsum) == _tree_bytes(flat.wsum)
+
+
+def test_single_slice_layout_matches_historical_fold():
+    """slices=[whole cohort] is bitwise identical to slices=None — the
+    n_aggregators=1 tree reproduces the flat fold outright."""
+    shapes = _params()
+    order = [str(i) for i in range(5)]
+    hist = StreamingFolder(shapes, order=order)
+    one = StreamingFolder(shapes, order=order, slices=[order])
+    for meta, wire, _ in _topk_updates(5):
+        hist.add(dict(meta), jax.tree.map(np.copy, wire))
+        one.add(dict(meta), jax.tree.map(np.copy, wire))
+    m_h, w_h, l_h = hist.mean()
+    m_o, w_o, l_o = one.mean()
+    assert (w_h, l_h) == (w_o, l_o)
+    assert _tree_bytes(m_h) == _tree_bytes(m_o)
+
+
+def test_straggler_outside_layout_folds_as_trailing_block():
+    shapes = _params()
+    updates = _topk_updates(5)
+    order = [str(i) for i in range(5)]
+    layout = slice_cohort(order[:4], 2)      # id "4" admitted past layout
+
+    flat = StreamingFolder(shapes, order=order, slices=layout)
+    for meta, wire, _ in updates:
+        flat.add(dict(meta), jax.tree.map(np.copy, wire))
+    flat.finalize()
+    assert flat.folded_ids == ["0", "1", "2", "3", "4"]
+
+    tree = _tree_fold(shapes, layout + [["4"]], updates)
+    assert _tree_bytes(tree.wsum) == _tree_bytes(flat.wsum)
+
+
+@pytest.fixture(scope="module")
+def placement():
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("needs the forced 8-device CPU host")
+    pl = partition.make_server_placement(
+        _params(), 4, "model", "bert", devices=devs[:4])
+    assert pl is not None
+    return pl
+
+
+def test_partial_combine_sharded_bitwise(placement):
+    """The tp-sharded root combines host partials bitwise identically to
+    the replicated root (slicing commutes with the adds)."""
+    shapes = placement.shapes_tree()
+    updates = _topk_updates(4)
+    order = [str(i) for i in range(4)]
+    layout = slice_cohort(order, 2)
+
+    rep = _tree_fold(shapes, layout, updates)
+    shd = _tree_fold(shapes, layout, updates, placement=placement)
+    m_rep, w_rep, _ = rep.mean()
+    m_shd, w_shd, _ = shd.mean()
+    assert w_rep == w_shd
+    host = partition.host_tree(m_shd)
+    assert _tree_bytes(m_rep) == _tree_bytes(host)
+    for leaf in jax.tree.leaves(m_shd):
+        assert isinstance(leaf, jax.Array)
+
+
+# ------------------------------------------------------ tree federation ----
+def _config(num_clients=3, n_agg=0, run_kw=None, **fed_kw):
+    fed = dict(strategy="fedavg", rounds=2, cohort_size=0, local_steps=3,
+               batch_size=16, lr=0.1, momentum=0.0)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=num_clients,
+                        partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="agg_tree_test", backend="cpu",
+                      num_aggregators=n_agg, **(run_kw or {})),
+    )
+
+
+def _run(cfg, n_workers, rounds=2, log_fn=None):
+    """One federation run; returns (history, final params as numpy)."""
+    n_agg = cfg.run.num_aggregators
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(n_workers)]
+        aggs = [AggregatorServer(cfg, a, broker.host, broker.port).start()
+                for a in range(n_agg)]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0)
+            coord.enroll(min_devices=n_workers, timeout=20.0)
+            if n_agg:
+                assert coord.enroll_aggregators(timeout=20.0)
+            hist = coord.fit(rounds=rounds,
+                             log_fn=(lambda rec: log_fn(rec, aggs))
+                             if log_fn else None)
+            params = jax.tree.map(np.asarray, coord.server_state.params)
+            coord.close()
+            return hist, params
+        finally:
+            for a in aggs:
+                a.stop()
+            for w in workers:
+                w.stop()
+
+
+def _max_diff(pa, pb):
+    la, lb = jax.tree.leaves(pa), jax.tree.leaves(pb)
+    return max(float(np.max(np.abs(a - b))) for a, b in zip(la, lb))
+
+
+@pytest.mark.parametrize("fed_kw", [{}, {"compress": "topk"}],
+                         ids=["dense", "topk"])
+def test_tree_federation_bitwise_vs_flat(fed_kw):
+    h_flat, p_flat = _run(_config(3, 0, **fed_kw), 3)
+    h_tree, p_tree = _run(_config(3, 2, **fed_kw), 3)
+    for rf, rt in zip(h_flat, h_tree):
+        assert rt["completed"] == rf["completed"]
+        assert not rt["dropped"]
+        assert rt["aggregators"] == 2          # tree-mode round record
+        assert "aggregators" not in rf
+    assert _max_diff(p_flat, p_tree) == 0.0
+
+
+def test_tree_federation_failover_rehomes_killed_aggregator():
+    reg = telemetry.get_registry()
+    before = reg.counter("comm.agg_failovers_total",
+                         labels={"action": "rehome"}).value
+
+    def kill_after_first_round(rec, aggs):
+        if rec["round"] == 0:
+            aggs[0].stop()       # dies mid-run; later rounds must re-home
+
+    cfg = _config(3, 2, run_kw={"agg_heartbeat_timeout": 2.0})
+    hist, params = _run(cfg, 3, rounds=3, log_fn=kill_after_first_round)
+    assert len(hist) == 3
+    assert all(not r["dropped"] for r in hist)
+    completed = hist[0]["completed"]
+    # The re-homed slice keeps every device training: no cohort loss.
+    assert all(r["completed"] == completed for r in hist)
+    assert any(r.get("agg_failovers") for r in hist[1:])
+    assert reg.counter("comm.agg_failovers_total",
+                       labels={"action": "rehome"}).value > before
+    assert np.isfinite(hist[-1]["train_loss"])
+
+
+@pytest.mark.parametrize("kx", ["shared_seed", "dh"])
+def test_tree_federation_secure_agg_exact(kx):
+    """Slice-local mask groups: every pair cancels inside one partial, so
+    the tree's secure mean matches the flat secure mean to float noise."""
+    fed_kw = dict(secure_agg=True, secure_agg_key_exchange=kx)
+    h_flat, p_flat = _run(_config(4, 0, **fed_kw), 4)
+    h_tree, p_tree = _run(_config(4, 2, **fed_kw), 4)
+    assert [r["completed"] for r in h_tree] == \
+        [r["completed"] for r in h_flat]
+    # Masks do not cancel bitwise across regrouped sums — but they DO
+    # cancel (a non-recovered mask would be O(1), not O(eps)).
+    assert _max_diff(p_flat, p_tree) < 5e-4

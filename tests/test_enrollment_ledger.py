@@ -1,0 +1,211 @@
+"""Durable enrollment (ckpt/wal.EnrollmentLedger) and challenge-on-resume
+(coordinator.verify_resumed_devices): the WAL-backed admission record a
+resumed coordinator trusts instead of replayable broker announcements,
+and the nonce-echo proof of key possession that gates readmission."""
+
+import json
+import os
+
+import pytest
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.ckpt import EnrollmentLedger
+from colearn_federated_learning_tpu.comm import enrollment, keyexchange
+from colearn_federated_learning_tpu.comm.broker import (
+    BrokerClient,
+    MessageBroker,
+)
+from colearn_federated_learning_tpu.comm.coordinator import FederatedCoordinator
+from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+from colearn_federated_learning_tpu.utils.config import (
+    DataConfig,
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+)
+
+
+class _Dev:
+    def __init__(self, device_id, host="127.0.0.1", port=1, pubkey=""):
+        self.device_id, self.host, self.port = device_id, host, port
+        self.pubkey = pubkey
+
+
+def _rejections(reason):
+    return telemetry.get_registry().counter(
+        "comm.enroll_challenge_rejected_total",
+        labels={"reason": reason}).value
+
+
+# ------------------------------------------------------------- ledger ----
+def test_ledger_appends_durably_and_latest_wins(tmp_path):
+    led = EnrollmentLedger(str(tmp_path))
+    led.admit(_Dev("0", port=7001, pubkey="aa"))
+    led.admit(_Dev("1", port=7002, pubkey="bb"))
+    led.admit(_Dev("0", port=7009, pubkey="cc"))    # key rotation
+    led.close()
+
+    fresh = EnrollmentLedger(str(tmp_path))         # reopen: survives
+    devs = fresh.devices()
+    assert set(devs) == {"0", "1"}
+    assert devs["0"]["port"] == 7009 and devs["0"]["pubkey"] == "cc"
+    assert devs["1"]["pubkey"] == "bb"
+
+
+def test_ledger_tolerates_torn_tail(tmp_path):
+    led = EnrollmentLedger(str(tmp_path))
+    led.admit(_Dev("0", pubkey="aa"))
+    led.close()
+    with open(led.path, "a", encoding="utf-8") as f:
+        f.write('{"device_id": "1", "pubk')       # append died mid-line
+    devs = EnrollmentLedger(str(tmp_path)).devices()
+    assert set(devs) == {"0"}
+
+
+# -------------------------------------------------- challenge-on-resume ----
+def _config(num_clients, ckpt_dir):
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=num_clients,
+                        partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32, depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=2, local_steps=2,
+                      batch_size=16, lr=0.1),
+        run=RunConfig(name="ledger_test", backend="cpu",
+                      checkpoint_dir=ckpt_dir),
+    )
+
+
+def _enroll_coordinator(cfg, broker, n):
+    coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                 round_timeout=20.0)
+    coord.enroll(min_devices=n, timeout=20.0)
+    return coord
+
+
+def test_resume_readmits_only_ledger_verified_devices(tmp_path):
+    """First enrollment writes the ledger; a resumed coordinator readmits
+    the recorded devices after they answer the nonce challenge — and
+    rejects a device whose announcement replayed (or was forged) but was
+    never admitted to the ledger."""
+    cfg = _config(3, str(tmp_path))
+    with MessageBroker() as broker:
+        first = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                 for i in range(2)]
+        late = None
+        try:
+            coord = _enroll_coordinator(cfg, broker, 2)
+            coord.close()
+            assert set(EnrollmentLedger(str(tmp_path)).devices()) == \
+                {"0", "1"}
+
+            # A third device announces AFTER the crash: its retained
+            # record replays into the resumed coordinator's enrollment,
+            # but no ledger line vouches for it.
+            late = DeviceWorker(cfg, 2, broker.host, broker.port).start()
+            base = _rejections("not_in_ledger")
+            resumed = _enroll_coordinator(cfg, broker, 3)
+            out = resumed.verify_resumed_devices()
+            assert sorted(out["verified"]) == ["0", "1"]
+            assert out["rejected"] == ["2"]
+            assert _rejections("not_in_ledger") == base + 1
+            survivors = {t.device_id for t in resumed.trainers} | (
+                {resumed.evaluator.device_id} if resumed.evaluator else set())
+            assert "2" not in survivors
+            resumed.close()
+            # The rejection is durable: the replay-recorded admission was
+            # revoked, so the impostor cannot pass a FUTURE resume on it.
+            assert "2" not in EnrollmentLedger(str(tmp_path)).devices()
+        finally:
+            for w in first + ([late] if late else []):
+                w.stop()
+
+
+def test_resume_rejects_forged_and_undecodable_ledger_keys(tmp_path):
+    """A device that cannot echo the nonce under the LEDGER's pubkey is an
+    impostor (bad_tag); an undecodable recorded key rejects too
+    (bad_ledger_key).  Neither is readmitted."""
+    cfg = _config(2, str(tmp_path))
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(2)]
+        try:
+            coord = _enroll_coordinator(cfg, broker, 2)
+            coord.close()
+
+            # Tamper the ledger: bind device 0 to a key it does not hold,
+            # and device 1 to garbage.
+            led = EnrollmentLedger(str(tmp_path))
+            devs = led.devices()
+            _, wrong_pub = keyexchange.generate_keypair()
+            e0 = dict(devs["0"], pubkey=keyexchange.encode_public(wrong_pub))
+            e1 = dict(devs["1"], pubkey="not-hex-not-a-key")
+            with open(led.path, "w", encoding="utf-8") as f:
+                f.write(json.dumps(e0) + "\n" + json.dumps(e1) + "\n")
+
+            base_tag = _rejections("bad_tag")
+            base_key = _rejections("bad_ledger_key")
+            resumed = _enroll_coordinator(cfg, broker, 2)
+            out = resumed.verify_resumed_devices()
+            assert out["verified"] == []
+            assert sorted(out["rejected"]) == ["0", "1"]
+            assert _rejections("bad_tag") == base_tag + 1
+            assert _rejections("bad_ledger_key") == base_key + 1
+            assert resumed.trainers == [] and resumed.evaluator is None
+            resumed.close()
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_preledger_entry_admits_on_presence_alone(tmp_path):
+    """Documented trust step-down: a ledger line without a pubkey (written
+    by a pre-identity build) readmits on ledger presence, no challenge."""
+    cfg = _config(2, str(tmp_path))
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(2)]
+        try:
+            coord = _enroll_coordinator(cfg, broker, 2)
+            coord.close()
+            led = EnrollmentLedger(str(tmp_path))
+            entries = [dict(e, pubkey="") for e in led.devices().values()]
+            with open(led.path, "w", encoding="utf-8") as f:
+                for e in entries:
+                    f.write(json.dumps(e) + "\n")
+
+            resumed = _enroll_coordinator(cfg, broker, 2)
+            out = resumed.verify_resumed_devices()
+            assert sorted(out["verified"]) == ["0", "1"]
+            assert out["rejected"] == []
+            resumed.close()
+        finally:
+            for w in workers:
+                w.stop()
+
+
+# ------------------------------------------------- announce supersession ----
+def test_reannounce_supersedes_stale_retained_record(tmp_path):
+    """A stale retained announcement (dead address, left over from before
+    a device restart) is superseded by the live re-announce — enrollment
+    connects to the CURRENT address, latest record wins."""
+    cfg = _config(1, str(tmp_path))
+    with MessageBroker() as broker:
+        stale = BrokerClient(broker.host, broker.port)
+        enrollment.announce(stale, enrollment.DeviceInfo(
+            device_id="0", host="127.0.0.1", port=9))   # nothing listens
+        stale.close()
+
+        worker = DeviceWorker(cfg, 0, broker.host, broker.port).start()
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=20.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=1, timeout=20.0)
+            assert [t.port for t in coord.trainers] == [worker.port]
+            # And the ledger recorded the live binding, not the stale one.
+            assert EnrollmentLedger(
+                str(tmp_path)).devices()["0"]["port"] == worker.port
+            coord.close()
+        finally:
+            worker.stop()

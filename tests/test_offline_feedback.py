@@ -1,0 +1,164 @@
+"""File-plane uplink error feedback (fed/offline.py): the persisted
+compression residual closes the same EF-SGD loop the socket worker runs
+in memory — carried only across consecutive rounds, reset (and counted)
+on torn/stale/mismatched carries, and refused outright under secure_agg."""
+
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.data import registry as data_registry
+from colearn_federated_learning_tpu.fed import compression, offline
+from colearn_federated_learning_tpu.utils.serialization import (
+    atomic_save_pytree_npz,
+    load_pytree_npz,
+)
+
+from tests.test_engine import tiny_config
+
+
+def _resets(reason):
+    return telemetry.get_registry().counter(
+        "fed.offline_residual_resets_total",
+        labels={"reason": reason}).value
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return data_registry.get_dataset("mnist_tiny", seed=0)
+
+
+def _leaves(tree):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(tree)]
+
+
+def _assert_close(a, b, atol=1e-6):
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_allclose(x, y, atol=atol, rtol=0)
+
+
+def _dense(wire, meta, shapes):
+    return compression.decompress_delta(wire, meta, shapes=shapes)
+
+
+def test_feedback_loop_reconstructs_the_exact_delta(tmp_path, ds):
+    """Round 0: wire + residual == the uncompressed delta.  Round 1 (same
+    global chain): wire + new residual == delta + carried residual — the
+    EF-SGD invariant, with no reset counted across the valid carry."""
+    dense_cfg = tiny_config(compress="none")
+    fb_cfg = tiny_config(compress="topk", compress_feedback=True,
+                         topk_fraction=0.05)
+    g0 = str(tmp_path / "global0.npz")
+    offline.init_global_model(dense_cfg, g0)
+    params, _ = load_pytree_npz(g0)
+    res = str(tmp_path / "residual.npz")
+
+    # ---- round 0 ----
+    u_dense0 = str(tmp_path / "dense0.npz")
+    offline.client_update(dense_cfg, 0, g0, u_dense0, dataset=ds)
+    delta0, _ = load_pytree_npz(u_dense0)
+
+    u_fb0 = str(tmp_path / "fb0.npz")
+    offline.client_update(fb_cfg, 0, g0, u_fb0, dataset=ds,
+                          residual_path=res)
+    wire0, m0 = load_pytree_npz(u_fb0)
+    residual0, rmeta0 = load_pytree_npz(res)
+    assert int(rmeta0["round"]) == 0
+    rec0 = _dense(wire0, m0, params)
+    _assert_close(
+        {"a": [np.add(x, y) for x, y in zip(_leaves(rec0),
+                                            _leaves(residual0))]},
+        {"a": _leaves(delta0)})
+
+    # ---- round 1, same global for both paths ----
+    g1 = str(tmp_path / "global1.npz")
+    offline.aggregate_updates(dense_cfg, g0, [u_dense0], g1)
+    u_dense1 = str(tmp_path / "dense1.npz")
+    offline.client_update(dense_cfg, 0, g1, u_dense1, dataset=ds)
+    delta1, _ = load_pytree_npz(u_dense1)
+
+    stale_before = _resets("stale")
+    u_fb1 = str(tmp_path / "fb1.npz")
+    offline.client_update(fb_cfg, 0, g1, u_fb1, dataset=ds,
+                          residual_path=res)
+    assert _resets("stale") == stale_before       # consecutive: carried
+    wire1, m1 = load_pytree_npz(u_fb1)
+    residual1, rmeta1 = load_pytree_npz(res)
+    assert int(rmeta1["round"]) == 1
+    lhs = [np.add(x, y) for x, y in zip(_leaves(_dense(wire1, m1, params)),
+                                        _leaves(residual1))]
+    rhs = [np.add(x, y) for x, y in zip(_leaves(delta1),
+                                        _leaves(residual0))]
+    _assert_close({"a": lhs}, {"a": rhs})
+
+
+def _fb_round0(cfg, tmp, ds, res_path, tag):
+    g = str(tmp / f"g_{tag}.npz")
+    offline.init_global_model(cfg, g)
+    out = str(tmp / f"u_{tag}.npz")
+    offline.client_update(cfg, 0, g, out, dataset=ds, residual_path=res_path)
+    return load_pytree_npz(out)
+
+
+@pytest.mark.parametrize("poison,reason", [
+    ("stale", "stale"), ("garbage", "torn"), ("shape", "shape"),
+])
+def test_invalid_residual_resets_and_counts(tmp_path, ds, poison, reason):
+    """A stale (non-consecutive round), torn, or shape-mismatched carry is
+    discarded — the update is bitwise the no-carry update — and the reset
+    is attributed on ``fed.offline_residual_resets_total``."""
+    cfg = tiny_config(compress="topk", compress_feedback=True)
+    clean_res = str(tmp_path / "clean_res.npz")
+    wire_ref, _ = _fb_round0(cfg, tmp_path, ds, clean_res, "ref")
+
+    bad_res = str(tmp_path / "bad_res.npz")
+    if poison == "stale":
+        # Valid tree, wrong round: produced 8 rounds ago, not round -1.
+        residual0, _ = load_pytree_npz(clean_res)
+        atomic_save_pytree_npz(bad_res, residual0, meta={"round": 7})
+    elif poison == "garbage":
+        with open(bad_res, "wb") as f:
+            f.write(b"not an npz archive")
+    else:
+        atomic_save_pytree_npz(bad_res, {"x": np.zeros(3, np.float32)},
+                               meta={"round": -1})
+
+    before = _resets(reason)
+    wire_bad, _ = _fb_round0(cfg, tmp_path, ds, bad_res, poison)
+    assert _resets(reason) == before + 1
+    for x, y in zip(_leaves(wire_ref), _leaves(wire_bad)):
+        np.testing.assert_array_equal(x, y)
+    # The poisoned carry was replaced by a fresh, valid one.
+    _, rmeta = load_pytree_npz(bad_res)
+    assert int(rmeta["round"]) == 0
+
+
+def test_secure_agg_refuses_offline_feedback(tmp_path, ds):
+    """Same rejection rule as the wire plane: a masked update leaves no
+    plaintext residual to feed back."""
+    cfg = tiny_config(compress="topk", compress_feedback=True,
+                      secure_agg=True)
+    g = str(tmp_path / "g.npz")
+    offline.init_global_model(tiny_config(), g)
+    with pytest.raises(ValueError, match="secure_agg"):
+        offline.client_update(cfg, 0, g, str(tmp_path / "u.npz"),
+                              dataset=ds,
+                              residual_path=str(tmp_path / "r.npz"))
+
+
+def test_no_residual_path_keeps_historical_wire(tmp_path, ds):
+    """compress_feedback without a residual_path (pre-flag callers) stays
+    byte-identical to the plain compressed update."""
+    plain = tiny_config(compress="topk")
+    fb = tiny_config(compress="topk", compress_feedback=True)
+    g = str(tmp_path / "g.npz")
+    offline.init_global_model(plain, g)
+    a, b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    offline.client_update(plain, 0, g, a, dataset=ds)
+    offline.client_update(fb, 0, g, b, dataset=ds)   # no residual_path
+    wa, _ = load_pytree_npz(a)
+    wb, _ = load_pytree_npz(b)
+    for x, y in zip(_leaves(wa), _leaves(wb)):
+        np.testing.assert_array_equal(x, y)

@@ -35,6 +35,17 @@ def test_kill_spec_validation():
         KillSpec("broker", after_round=0, restart=False)
 
 
+def test_kill_spec_accepts_aggregator_targets():
+    KillSpec("aggregator:0", after_round=1)
+    # Unlike coordinator/broker, a dead aggregator need not restart: the
+    # root re-homes its slice to a sibling (the failover under test).
+    KillSpec("aggregator:1", after_round=0, restart=False)
+    with pytest.raises(ValueError, match="target"):
+        KillSpec("aggregator", after_round=0)
+    with pytest.raises(ValueError, match="target"):
+        KillSpec("aggregator:x", after_round=0)
+
+
 def test_canned_schedule_scales_with_run_length():
     short = canned_kill_schedule(3, 2)
     assert [k.target for k in short] == ["coordinator"]
