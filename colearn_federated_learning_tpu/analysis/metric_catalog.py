@@ -57,6 +57,10 @@ COUNTERS = (
     "comm.agg_folds_total",             # labeled {agg=<id>}: partials folded
     "comm.agg_failovers_total",         # labeled {action=rehome|drop}
     "comm.agg_heartbeat_expired_total",  # stale heartbeat seen at dispatch
+    "comm.agg_partials_folded_total",   # root-side, labeled {agg=<id>}
+    # health ledger (telemetry/health.py)
+    "health.ledger_appends_total",
+    "health.ledger_compactions_total",
     # durable enrollment + challenge-on-resume (ckpt/wal.py EnrollmentLedger,
     # comm/coordinator.py verify_resumed_devices)
     "comm.enroll_ledger_appends_total",
@@ -126,6 +130,13 @@ GAUGES = (
     "runtime.hbm_bytes_in_use",
     "runtime.hbm_bytes_limit",
     "runtime.hbm_peak_bytes_in_use",
+    # aggregator tier visibility (comm/coordinator.py → `colearn top`)
+    "comm.agg_heartbeat_age_s",      # labeled {agg=<id>}: announce staleness
+    "comm.agg_slice_devices",        # labeled {agg=<id>}: dispatch slice size
+    # health ledger exports (telemetry/health.py export_gauges)
+    "health.devices_tracked",
+    "health.device_score",           # labeled {device=<id>}: offender rank
+    "health.device_latency_ewma_s",  # labeled {device=<id>}
 )
 
 # Histograms ---------------------------------------------------------------
@@ -134,8 +145,10 @@ HISTOGRAMS = (
     "ckpt.restore_s",
     "engine.round_time_s",
     "fed.round_time_s",
+    "fed.phase_time_s",      # labeled {phase=broadcast_collect|aggregate|...}
     "async.agg_time_s",
     "fleetsim.round_time_s",
+    "comm.agg_fold_time_s",  # labeled {agg=<id>}: middle-tier slice folds
 )
 
 # Counters whose soak-window delta faults/soak.py reports (a curated

@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL013).
+"""The colearn rule set (CL001–CL014).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -793,3 +793,97 @@ class FullShapeMaterializeInHotAggregation(Rule):
                     "O(model) host work per update; stage sparse "
                     "(indices, values) and scatter-add at finalize "
                     "(StreamingFolder._stage_topk)")
+
+
+# ----------------------------------------------------------------- CL014 --
+@register
+class UnattributedTimingInHotWirePath(Rule):
+    """The fleet health plane (PR 12) attributes every hot-path duration
+    to a named sink: a tracer span (stitched into the round trace), a
+    registry histogram (``fed.phase_time_s`` / ``comm.agg_fold_time_s``),
+    or an accumulated stat shipped in round meta (``fold_s``).  A raw
+    wall-clock delta — ``time.time() - t0`` computed in a ``# colearn:
+    hot`` comm region and not fed into one of those sinks — is a timing
+    measurement the health ledger, ``colearn top``, and the sentinel
+    windows never see: it ages into a print/log or a local nobody reads.
+    Accumulations (``self.fold_s += perf_counter() - t0``) and deltas
+    passed straight into ``observe``/``set``/``record``/``inc`` are
+    attributed and stay clean."""
+
+    id = "CL014"
+    title = "unattributed wall-clock delta on a hot wire path"
+    hint = ("time it with `tracer.span(...)` or feed the delta to a "
+            "registry histogram (fed.phase_time_s) / the health ledger; "
+            "mark a justified raw delta with `# colearn: noqa(CL014)`")
+
+    _CLOCKS = {"time.time", "time.monotonic", "time.perf_counter",
+               "perf_counter", "monotonic"}
+    _SINKS = {"observe", "set", "record", "inc", "set_attr"}
+    _REGIONS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.For, ast.While,
+                ast.With)
+
+    def _delta(self, node: ast.AST) -> Optional[str]:
+        # A duration is clock-call-minus-start; deadline arithmetic
+        # (``deadline - time.monotonic()``) keeps the clock on the right
+        # and is budget bookkeeping, not a measurement.
+        if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+            return None
+        if not isinstance(node.left, ast.Call):
+            return None
+        dotted = dotted_name(node.left.func)
+        if dotted not in self._CLOCKS:
+            return None
+        return f"{dotted}() - ..."
+
+    def _attributed(self, tree: ast.AST) -> set:
+        """ids of every node under an AugAssign value (stat accumulation)
+        or a metric-sink call argument — deltas landing there are fed to
+        a named series and exempt."""
+        out: set = set()
+        for node in ast.walk(tree):
+            roots: tuple = ()
+            if isinstance(node, ast.AugAssign):
+                roots = (node.value,)
+            elif isinstance(node, ast.Call):
+                # ``reg.histogram(...).observe(dt)`` roots the attribute
+                # chain at a Call, so read the attr directly rather than
+                # via dotted_name (which needs a Name root).
+                func = node.func
+                tail = (func.attr if isinstance(func, ast.Attribute)
+                        else dotted_name(func))
+                if tail in self._SINKS:
+                    roots = tuple(node.args) + tuple(
+                        kw.value for kw in node.keywords)
+            for root in roots:
+                out.update(id(n) for n in ast.walk(root))
+        return out
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("comm"):
+            return
+        hot = ctx.hot_lines()
+        if not hot:
+            return
+        attributed = self._attributed(ctx.tree)
+        seen: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, self._REGIONS) and node.lineno in hot:
+                inners: Iterator[ast.AST] = ast.walk(node)
+            elif node.__class__ is ast.BinOp and node.lineno in hot:
+                inners = iter((node,))
+            else:
+                continue
+            for inner in inners:
+                what = self._delta(inner)
+                if what is None or id(inner) in attributed:
+                    continue
+                key = (inner.lineno, inner.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, inner,
+                    f"{what} inside a `# colearn: hot` wire path is a "
+                    "duration no sink ever sees; route it through a "
+                    "tracer span or a registry histogram so the health "
+                    "plane can attribute it")

@@ -162,6 +162,12 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="worker-side role-assignment window in seconds; "
                         "expiry raises EnrollmentTimeout instead of "
                         "hanging")
+    p.add_argument("--health-dir", default=None,
+                   help="per-device health ledger directory "
+                        "(telemetry/health.py): coordinator/aggregator/"
+                        "fleetsim durably record deadline misses, "
+                        "retries, latency sketches per device "
+                        "(`colearn health` reads it)")
     p.add_argument("--fault-plan", default=None,
                    help="JSON fault-plan file (faults/plan.py) installed "
                         "on this process's transport — deterministic "
@@ -250,7 +256,8 @@ _RUN_KEYS = {"backend", "seed", "tp_size", "eval_every", "log_every",
              "checkpoint_every", "profile_dir", "trace_dir", "trace_rounds",
              "evict_after", "worker_enroll_timeout", "comm_retries",
              "comm_backoff_base", "comm_backoff_max", "fault_plan",
-             "fault_seed", "num_aggregators", "agg_heartbeat_timeout"}
+             "fault_seed", "num_aggregators", "agg_heartbeat_timeout",
+             "health_dir"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
@@ -427,7 +434,7 @@ def cmd_broker(args: argparse.Namespace) -> int:
         if events is not None:
             events.emit("stop", role="broker")
         if exporter is not None:
-            exporter.stop()
+            exporter.close()
     return 0
 
 
@@ -665,6 +672,8 @@ def cmd_chaos(args: argparse.Namespace) -> int:
               # with the kill armed, the tree must have re-homed or
               # quorum-dropped at least one slice, the postmortem must
               # attribute the kill, and the flight dump must exist.
+              # The tree run's health ledgers must survive the kill.
+              and summary["health_ledger_ok"]
               and (args.no_faults
                    or (summary["agg_failovers"] >= 1
                        and summary["postmortem_attributed"]
@@ -977,6 +986,24 @@ def cmd_sentinel(args: argparse.Namespace) -> int:
     else:
         print(sentinel.render_verdict(verdict))
     return 0 if verdict["ok"] else 1
+
+
+def cmd_health(args: argparse.Namespace) -> int:
+    """Render the per-device health ledger a --health-dir run wrote: top
+    offenders, straggler latency tail, per-aggregator slice skew."""
+    from colearn_federated_learning_tpu import telemetry
+
+    try:
+        devices = telemetry.load_health(args.health_dir)
+    except (OSError, ValueError) as e:
+        print(f"colearn health: cannot read {args.health_dir}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps({d: h.to_dict() for d, h in devices.items()}))
+    else:
+        print(telemetry.render_health(devices, top=args.top))
+    return 0 if devices else 1
 
 
 def cmd_configs(_args: argparse.Namespace) -> int:
@@ -1292,6 +1319,19 @@ def main(argv: list[str] | None = None) -> int:
                             "else the package parent)")
     p_slo.add_argument("--format", choices=["text", "json"], default="text")
     p_slo.set_defaults(fn=cmd_sentinel)
+
+    p_health = sub.add_parser("health",
+                              help="per-device fleet health from a "
+                                   "--health-dir run: top offenders, "
+                                   "straggler tail, per-aggregator skew")
+    p_health.add_argument("health_dir",
+                          help="directory holding health_*.jsonl ledgers "
+                               "(searched recursively)")
+    p_health.add_argument("--top", type=int, default=10,
+                          help="offender rows to show")
+    p_health.add_argument("--format", choices=["text", "json"],
+                          default="text")
+    p_health.set_defaults(fn=cmd_health)
 
     p_bench = sub.add_parser("bench", help="run the headline benchmark")
     p_bench.add_argument("--rounds", type=int, default=20)

@@ -103,6 +103,18 @@ class AggregatorServer:
                  heartbeat_s: float = 0.5):
         self.config = config
         self.agg_id = int(agg_id)
+        # Spans are captured per request and shipped UPSTREAM in the
+        # reply meta (the root owns the stitched trace); the local buffer
+        # additionally feeds the flight recorder's span tail, so a
+        # SIGKILLed aggregator's last folds survive in its flight dump.
+        self.tracer = telemetry.Tracer(
+            process=f"aggregator-{self.agg_id}", max_spans=4096)
+        # Per-device health feed (telemetry/health.py), gated on the run
+        # config so the default data path writes nothing.
+        self.health = None
+        if config.run.health_dir:
+            self.health = telemetry.HealthLedger(
+                config.run.health_dir, f"aggregator{self.agg_id}")
         self._server = TensorServer(self._handle, host=host, port=port,
                                     ident=f"agg:{self.agg_id}")
         self._broker_addr = (broker_host, broker_port)
@@ -194,7 +206,16 @@ class AggregatorServer:
 
     def _fold(self, header: dict, tree: Any) -> tuple[dict, Any]:
         """Relay the broadcast to this slice's devices, fold the replies
-        sparse-natively, reply with ONE partial sum."""
+        sparse-natively, reply with ONE partial sum.
+
+        Trace stitching: the whole slice-fold runs under an
+        ``aggregator.fold`` span parented on the root's round span (the
+        fold request carries the root's context); each relayed train
+        request carries THIS span's context, so worker spans parent onto
+        the tier that actually dispatched them.  The reply ships the
+        harvested worker spans plus this tier's own captured spans
+        upstream, completing the coordinator → aggregator → worker chain
+        in one trace."""
         from colearn_federated_learning_tpu.comm.aggregation import (
             StreamingFolder,
         )
@@ -211,6 +232,7 @@ class AggregatorServer:
         shares_in = header.get("shares_in") or {}
         budget = float(header.get("timeout", 30.0))
         meta_in = header.get("meta") or {}
+        ctx = protocol.extract_trace(header)
         # Serialize-once per tier: ONE re-encode of the decoded broadcast,
         # shared read-only by every slice send below.
         body = memoryview(pytree_to_bytes(tree, meta_in or None))
@@ -220,74 +242,126 @@ class AggregatorServer:
         folder = StreamingFolder(tree, order=order)
         stale: list[str] = []
         failed: list[str] = []
+        worker_spans: list = []
         deadline = time.monotonic() + budget
 
-        def ask(dev):
-            did, dhost, dport = str(int(dev[0])), str(dev[1]), int(dev[2])
-            req = {"op": "train", "round": r}
-            if cohort is not None:
-                req["cohort"] = cohort
-            inbox = shares_in.get(did)
-            if inbox:
-                req["shares_in"] = inbox
-            cli = TensorClient(dhost, dport, timeout=protocol.CONNECT_TIMEOUT,
-                               ident=did)
-            try:
-                hdr, delta = cli.request(req, body=body, timeout=budget,
-                                         retry=self.retry, deadline=deadline)
-                if hdr.get("status") != "ok":
-                    raise RuntimeError(f"{did}: {hdr.get('error')}")
-                return hdr["meta"], delta
-            finally:
-                cli.close()
+        with self.tracer.capture() as captured:
+            with self.tracer.span("aggregator.fold", parent=ctx,
+                                  agg=self.agg_id, round=r) as fold_sp:
+                # Pool threads below have empty span stacks; hand them the
+                # fold span's identity explicitly (the coordinator's
+                # fan-out does the same with its round context).
+                fold_ctx = fold_sp.context
 
-        if devices:
-            with cf.ThreadPoolExecutor(
-                    max_workers=len(devices),
-                    thread_name_prefix=f"agg{self.agg_id}-fanout") as pool:
-                futs = {pool.submit(ask, d): str(int(d[0])) for d in devices}
-                pending = dict(futs)
-
-                def take(fut, did):
+                def ask(dev):
+                    did, dhost, dport = (str(int(dev[0])), str(dev[1]),
+                                         int(dev[2]))
+                    req = {"op": "train", "round": r}
+                    protocol.attach_trace(req, fold_ctx)
+                    if cohort is not None:
+                        req["cohort"] = cohort
+                    inbox = shares_in.get(did)
+                    if inbox:
+                        req["shares_in"] = inbox
+                    cli = TensorClient(dhost, dport,
+                                       timeout=protocol.CONNECT_TIMEOUT,
+                                       ident=did)
                     try:
-                        meta, delta = fut.result()
-                    except Exception:
-                        failed.append(did)
-                        return
-                    if int(meta.get("round", r)) != r:
-                        stale.append(str(meta.get("client_id", did)))
-                        return
-                    folder.add(meta, delta)
+                        hdr, delta = cli.request(req, body=body,
+                                                 timeout=budget,
+                                                 retry=self.retry,
+                                                 deadline=deadline)
+                        if hdr.get("status") != "ok":
+                            raise RuntimeError(f"{did}: {hdr.get('error')}")
+                        return hdr["meta"], delta
+                    finally:
+                        cli.close()
 
-                try:
-                    for fut in cf.as_completed(futs, timeout=budget):
-                        take(fut, pending.pop(fut))
-                except cf.TimeoutError:     # colearn: noqa(CL003)
-                    pass    # stragglers: charged below, like the root's
-                for fut, did in pending.items():
-                    if fut.done():
-                        # Completed in the race window after as_completed
-                        # gave up — the reply is here, use it (same
-                        # leniency as the root's fan-out).
-                        take(fut, did)
-                    else:
-                        fut.cancel()
-                        failed.append(did)
-        folder.finalize()
+                if devices:
+                    with cf.ThreadPoolExecutor(
+                            max_workers=len(devices),
+                            thread_name_prefix=f"agg{self.agg_id}-fanout",
+                    ) as pool:
+                        futs = {pool.submit(ask, d): str(int(d[0]))
+                                for d in devices}
+                        pending = dict(futs)
+
+                        def take(fut, did):
+                            try:
+                                meta, delta = fut.result()
+                            except Exception:
+                                failed.append(did)
+                                return
+                            # Harvest the worker's spans (runs on the
+                            # handler thread — no locking needed).  The
+                            # worker.train span doubles as the device's
+                            # observed round latency for the health feed.
+                            spans = meta.pop(protocol.TRACE_SPANS_KEY,
+                                             None) or []
+                            worker_spans.extend(spans)
+                            if self.health is not None:
+                                for sd in spans:
+                                    if str(sd.get("name")) == "worker.train":
+                                        self.health.record(
+                                            did, round=r,
+                                            agg=str(self.agg_id),
+                                            latency_s=float(
+                                                sd.get("duration_s", 0.0)))
+                            if int(meta.get("round", r)) != r:
+                                stale.append(str(meta.get("client_id",
+                                                          did)))
+                                return
+                            folder.add(meta, delta)
+
+                        try:
+                            for fut in cf.as_completed(futs,
+                                                       timeout=budget):
+                                take(fut, pending.pop(fut))
+                        except cf.TimeoutError:     # colearn: noqa(CL003)
+                            pass    # stragglers: charged below
+                        for fut, did in pending.items():
+                            if fut.done():
+                                # Completed in the race window after
+                                # as_completed gave up — the reply is
+                                # here, use it (same leniency as the
+                                # root's fan-out).
+                                take(fut, did)
+                            else:
+                                fut.cancel()
+                                failed.append(did)
+                folder.finalize()
         reg = telemetry.get_registry()
         reg.counter("comm.agg_folds_total",
                     labels={"agg": str(self.agg_id)}).inc()
+        reg.histogram("comm.agg_fold_time_s",
+                      labels={"agg": str(self.agg_id)}).observe(
+                          fold_sp.duration_s)
+        failed_ids = sorted(set(failed), key=order.index)
+        if self.health is not None:
+            for did in failed_ids:
+                self.health.record(did, round=r, agg=str(self.agg_id),
+                                   deadline_miss=1)
+            self.health.flush()
         out_meta = {
             "agg_id": self.agg_id,
             "round": r,
             "total_w": folder.total_w,
             "loss_sum": folder.loss_sum,
             "folded_ids": folder.folded_ids,
-            "failed": sorted(set(failed), key=order.index),
+            "failed": failed_ids,
             "stale": stale,
             "fold_s": folder.fold_s,
+            # Whole-tier wall time (span clock), distinct from fold_s
+            # (CPU spent inside StreamingFolder.add/finalize): the root
+            # records both as per-tier phase timings.
+            "fold_wall_s": fold_sp.duration_s,
             "densify_avoided": folder.densify_avoided,
         }
+        if ctx is not None:
+            # Ship the whole tier's trace upstream: the workers' spans
+            # plus our own (the fold span and anything under it).
+            out_meta[protocol.TRACE_SPANS_KEY] = (
+                worker_spans + [s.to_dict() for s in captured])
         if folder.wsum is None:
             return ({"meta": out_meta}, None)
         return ({"meta": out_meta}, folder.wsum)
@@ -307,7 +381,14 @@ def run_aggregator_forever(config: ExperimentConfig, agg_id: int,
                            heartbeat_s: float = 0.5) -> None:
     """CLI entry: announce, heartbeat, serve folds until killed."""
     agg = AggregatorServer(config, agg_id, broker_host, broker_port,
-                           heartbeat_s=heartbeat_s).start()
+                           heartbeat_s=heartbeat_s)
+    recorder = telemetry.get_flight_recorder()
+    if recorder is not None:
+        # Postmortem coverage for the middle tier: the recorder's
+        # heartbeat dumps this tracer's span tail alongside the event
+        # ring, so a SIGKILLed aggregator's last folds are attributable.
+        recorder.attach_tracer(agg.tracer)
+    agg.start()
     try:
         threading.Event().wait()
     finally:

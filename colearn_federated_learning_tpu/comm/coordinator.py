@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import math
+import os
 import time
 from typing import Optional
 
@@ -223,6 +224,14 @@ class FederatedCoordinator:
         # between cadence points.
         self._wal = None
         self._last_accepted: list[int] = []
+        # Per-device health ledger (telemetry/health.py): durable
+        # straggler attribution, gated on run.health_dir so the default
+        # data path writes nothing and round records stay byte-identical.
+        self.health = None
+        self._health_retry_seen: dict[str, float] = {}
+        if config.run.health_dir:
+            self.health = telemetry.HealthLedger(config.run.health_dir,
+                                                 "coordinator")
         # RDP accounting mirrors the engine's; each round is charged with
         # the ACTUAL cohort fraction and REALIZED noise (membership is
         # elastic here and stragglers drop mid-round).
@@ -411,7 +420,12 @@ class FederatedCoordinator:
         live = []
         reg = telemetry.get_registry()
         for agg_id in sorted(self._aggs):
-            if now - self._aggs[agg_id]["ts"] <= self.agg_heartbeat_timeout:
+            age = now - self._aggs[agg_id]["ts"]
+            # Live tier visibility for `colearn top` / the Prometheus
+            # endpoint: last-observed heartbeat age per aggregator.
+            reg.gauge("comm.agg_heartbeat_age_s",
+                      labels={"agg": str(agg_id)}).set(age)
+            if age <= self.agg_heartbeat_timeout:
                 live.append(agg_id)
             else:
                 reg.counter("comm.agg_heartbeat_expired_total").inc()
@@ -438,6 +452,9 @@ class FederatedCoordinator:
         if self._wal is not None:
             self._wal.close()
             self._wal = None
+        if self.health is not None:
+            self.health.flush()
+            self.health.close()
 
     def __enter__(self):
         return self
@@ -652,6 +669,15 @@ class FederatedCoordinator:
         reg.counter("fed.clients_dropped").inc(len(rec["dropped"]))
         reg.counter("fed.clients_evicted").inc(len(rec["evicted"]))
         reg.histogram("fed.round_time_s").observe(rec["round_time_s"])
+        # Per-phase latency as labeled children of one family — the
+        # labeled-summary rendering on /metrics breaks a round down
+        # without a trace file.
+        for phase, key in (("broadcast_collect", "phase_broadcast_collect_s"),
+                           ("aggregate", "phase_aggregate_s"),
+                           ("agg_fold", "phase_agg_fold_s")):
+            if key in rec:
+                reg.histogram("fed.phase_time_s",
+                              labels={"phase": phase}).observe(rec[key])
         self.history.append(rec)
         return rec
 
@@ -786,6 +812,10 @@ class FederatedCoordinator:
 
             def fold(dev: DeviceInfo, res) -> None:
                 meta, delta = res
+                if self.health is not None:
+                    # Observed per-device round latency, read from the
+                    # worker's own train span BEFORE it is popped.
+                    self._health_note_worker(meta, r)
                 _pop_worker_spans(meta, self.tracer)
                 if int(meta.get("round", r)) != r:   # stale update: refuse
                     stale.append(str(meta.get("client_id")))
@@ -914,6 +944,10 @@ class FederatedCoordinator:
             rec["uplink_densify_avoided"] = folder.densify_avoided
         if tree_mode:
             rec["aggregators"] = self.num_aggregators
+            # Middle-tier wall time (slowest slice fold — slices run
+            # concurrently, so this is the tier's critical path): the
+            # per-tier phase breakdown PERF.md tabulates.
+            rec["phase_agg_fold_s"] = tree_stats["fold_wall_s"]
             if tree_stats["failovers"]:
                 # Conditional key (nonzero only): the agg chaos soak
                 # asserts on it, default tree records stay byte-stable.
@@ -937,7 +971,55 @@ class FederatedCoordinator:
                                      noise_multiplier=sigma_eff)
             rec["dp_epsilon"] = self.accountant.epsilon()
             rec["dp_delta"] = self.accountant.delta
+        if self.health is not None:
+            fleet = self._health_round_feed(r, pruned, dropped, evicted,
+                                            tree_mode, tree_stats)
+            # health_* summary keys exist ONLY when the plane is on —
+            # default round records stay byte-identical.
+            rec.update(telemetry.health_record_keys(fleet))
         return rec
+
+    # ---- health plane (telemetry/health.py) ------------------------------
+    def _health_note_worker(self, meta: dict, r: int) -> None:
+        """Per-device observed latency from the worker's own train span
+        in the reply meta (flat mode; in tree mode the owning aggregator
+        records its slice)."""
+        for sd in meta.get(protocol.TRACE_SPANS_KEY) or []:
+            if str(sd.get("name")) != "worker.train":
+                continue
+            did = str((sd.get("attrs") or {}).get(
+                "client_id", meta.get("client_id", "")))
+            if did:
+                self.health.record(
+                    did, round=r,
+                    latency_s=float(sd.get("duration_s", 0.0)))
+
+    def _health_round_feed(self, r: int, pruned, dropped, evicted,
+                           tree_mode: bool, tree_stats) -> dict:
+        """End-of-round attribution: deadline misses (tree mode feeds
+        only whole-slice drops — per-device misses were recorded by the
+        owning aggregator), share-phase prunes as secure-agg dropouts,
+        evictions, and the transport's per-device retry deltas.  One
+        durable flush per round.  Returns the MERGED fleet view — in
+        tree mode the per-device latency lives in the aggregators'
+        ledger files, so the round stamps and the labeled gauges read
+        the whole directory, not just this process's records."""
+        from colearn_federated_learning_tpu.telemetry import health as _hl
+
+        pruned_set = set(pruned)
+        miss = (tree_stats["slice_dropped"] if tree_mode
+                else [d for d in dropped if d not in pruned_set])
+        for did in miss:
+            self.health.record(str(did), round=r, deadline_miss=1)
+        for did in pruned:
+            self.health.record(str(did), round=r, secure_dropout=1)
+        for did in evicted:
+            self.health.record(str(did), round=r, eviction=1)
+        _hl.feed_transport_retries(self.health, self._health_retry_seen)
+        self.health.flush()
+        fleet = _hl.load_health(os.path.dirname(self.health.path))
+        _hl.export_gauges(fleet)
+        return fleet
 
     def _tree_collect(self, r: int, slices, body, share_info, folder,
                       timeout: float, secure: bool, stale: list,
@@ -1010,6 +1092,14 @@ class FederatedCoordinator:
 
         results: dict[int, tuple[dict, bool]] = {}
         work = [(i, sl) for i, sl in enumerate(slices) if sl]
+        if agg_order:
+            for i, sl in work:
+                # Dispatch-time slice size per assigned aggregator — the
+                # `colearn top` tier view's "slice" column.
+                reg.gauge(
+                    "comm.agg_slice_devices",
+                    labels={"agg": str(agg_order[i % len(agg_order)])},
+                ).set(len(sl))
         if work:
             with cf.ThreadPoolExecutor(
                     max_workers=len(work),
@@ -1022,6 +1112,14 @@ class FederatedCoordinator:
                         meta, tree, rehomed = fut.result()
                     except Exception:   # slice dropped: charged below
                         return
+                    # Adopt the tier's spans — the aggregator's fold span
+                    # plus the worker spans it harvested — into the round
+                    # trace (take() runs on the MAIN thread, same as the
+                    # fold below), completing the stitched timeline.
+                    _pop_worker_spans(meta, self.tracer)
+                    reg.counter(
+                        "comm.agg_partials_folded_total",
+                        labels={"agg": str(meta.get("agg_id", "?"))}).inc()
                     results[i] = (meta, rehomed)
                     # Partials fold under slice keys on the MAIN thread,
                     # arrival order immaterial (finalize re-orders).
@@ -1044,6 +1142,8 @@ class FederatedCoordinator:
         rehomes = drops = 0
         received: list[int] = []
         failed: list[str] = []
+        slice_dropped: list[str] = []
+        fold_walls: list[float] = []
         slice_recv: list[list[int]] = [[] for _ in slices]
         for i, sl in enumerate(slices):
             got = results.get(i)
@@ -1051,6 +1151,10 @@ class FederatedCoordinator:
                 if sl:
                     drops += 1
                     failed.extend(d.device_id for d in sl)
+                    # Whole-slice loss (the aggregator died): the owning
+                    # aggregator could not attribute these devices, so
+                    # the root's health feed does.
+                    slice_dropped.extend(d.device_id for d in sl)
                 continue
             meta, rehomed = got
             if rehomed:
@@ -1064,6 +1168,7 @@ class FederatedCoordinator:
             # same accounting slot as the root's own streaming overlap.
             folder.fold_s += float(meta.get("fold_s", 0.0))
             folder.densify_avoided += int(meta.get("densify_avoided", 0))
+            fold_walls.append(float(meta.get("fold_wall_s", 0.0)))
         if rehomes:
             reg.counter("comm.agg_failovers_total",
                         labels={"action": "rehome"}).inc(rehomes)
@@ -1075,7 +1180,11 @@ class FederatedCoordinator:
                 self._uplink_saved_per_update * len(received))
         return {"received": received, "failed": failed,
                 "slice_ids": slice_ids, "slice_received": slice_recv,
-                "failovers": rehomes + drops}
+                "failovers": rehomes + drops,
+                "slice_dropped": slice_dropped,
+                # The tier's critical path: the SLOWEST slice fold's wall
+                # time (slices run concurrently).
+                "fold_wall_s": max(fold_walls) if fold_walls else 0.0}
 
     def _share_phase(self, r: int, cohort, ctx, cohort_of=None):
         """Collect every cohort member's encrypted recovery shares

@@ -257,6 +257,7 @@ def run_proc_soak(
     timeout_s: float = 600.0,
     seed: int = 0,
     n_aggregators: int = 0,
+    health: bool = False,
     log_fn: Optional[Callable[[dict], None]] = None,
 ) -> dict:
     """Run one multi-process soak and return its summary.
@@ -318,8 +319,14 @@ def run_proc_soak(
         # in the flight ledger like any other victim's.
         flight_flags = ["--flight-dir", flight_dir,
                         "--flight-heartbeat", "0.5"]
+        # Health flags ride on the federation roles only — the broker's
+        # parser has no override flags, and the ledger is written by the
+        # coordinator/aggregator planes anyway.
+        health_flags = (["--health-dir", os.path.join(workdir, "health")]
+                        if health else [])
         host, port = fleet.start_broker(timeout=30.0, extra=flight_flags)
-        worker_cfg = _config_flags(rounds, n_workers, seed) + flight_flags
+        worker_cfg = (_config_flags(rounds, n_workers, seed)
+                      + flight_flags + health_flags)
         for i in range(n_workers):
             fleet.start_worker(i, worker_cfg, host, port)
         # Aggregator tier (tree ingest): spawned between broker and
@@ -328,8 +335,9 @@ def run_proc_soak(
         agg_cfg = worker_cfg
         for a in range(n_aggregators):
             fleet.start_aggregator(a, agg_cfg, host, port)
-        coord_cfg = _config_flags(rounds, n_workers, seed,
-                                  checkpoint_dir=ckpt_dir) + flight_flags
+        coord_cfg = (_config_flags(rounds, n_workers, seed,
+                                   checkpoint_dir=ckpt_dir)
+                     + flight_flags + health_flags)
         if n_aggregators:
             coord_cfg += ["--num-aggregators", str(n_aggregators)]
 
@@ -524,7 +532,10 @@ def run_agg_soak(
     float non-associativity between arrival-order flat folds and
     slice-blocked tree folds, same bound as the secure-soak gate).  The
     killed aggregator must also have left a parseable flight dump whose
-    postmortem attributes the death to the aggregator role."""
+    postmortem attributes the death to the aggregator role, and the tree
+    run's ``--health-dir`` ledgers must survive the kill: parseable and
+    non-empty (``health_ledger_ok``/``health_devices`` in the summary,
+    the same files `colearn health <workdir>/tree/health` renders)."""
     workdir = workdir or tempfile.mkdtemp(prefix="colearn_aggsoak_")
     os.makedirs(workdir, exist_ok=True)
     kills = ([KillSpec("aggregator:0",
@@ -536,12 +547,18 @@ def run_agg_soak(
         rounds=rounds, n_workers=n_workers, kills=kills,
         workdir=os.path.join(workdir, "tree"),
         round_timeout=round_timeout, enroll_timeout=enroll_timeout,
-        timeout_s=timeout_s, seed=seed, n_aggregators=2, log_fn=log_fn)
+        timeout_s=timeout_s, seed=seed, n_aggregators=2, health=True,
+        log_fn=log_fn)
+    # The oracle flies with the health plane too: the ledger's per-round
+    # fsync shifts arrival timing, and the flat fold is arrival-order —
+    # an asymmetric config costs an ulp of fold-order noise in the
+    # param comparison for no reason.
     oracle = run_proc_soak(
         rounds=rounds, n_workers=n_workers, kills=[],
         workdir=os.path.join(workdir, "flat"),
         round_timeout=round_timeout, enroll_timeout=enroll_timeout,
-        timeout_s=timeout_s, seed=seed, n_aggregators=0, log_fn=log_fn)
+        timeout_s=timeout_s, seed=seed, n_aggregators=0, health=True,
+        log_fn=log_fn)
 
     state_t, step_t = _final_checkpoint_state(
         os.path.join(workdir, "tree", "ckpt"))
@@ -571,6 +588,21 @@ def run_agg_soak(
     else:
         attributed = not kill
 
+    # Health-ledger durability: every tree role flew with --health-dir,
+    # and the fsync-per-flush WAL discipline means the SIGKILLed
+    # aggregator's per-device records must still PARSE (a torn final
+    # line is tolerated; mid-file corruption raises) and must not be
+    # empty — straggler attribution that dies with its process is no
+    # attribution at all.
+    from colearn_federated_learning_tpu.telemetry import health as _health
+
+    try:
+        devices = _health.load_health(os.path.join(workdir, "tree",
+                                                   "health"))
+    except ValueError:
+        devices = {}
+    health_ok = bool(devices)
+
     return {
         "exit_code": tree["exit_code"],
         "oracle_exit_code": oracle["exit_code"],
@@ -581,6 +613,8 @@ def run_agg_soak(
         "checkpoint_step": step_t,
         "agg_failovers": tree["agg_failovers"],
         "postmortem_attributed": attributed,
+        "health_ledger_ok": health_ok,
+        "health_devices": len(devices),
         "flight_missing": tree["flight_missing"],
         "kills": tree["kills"],
         "records": tree["records"],

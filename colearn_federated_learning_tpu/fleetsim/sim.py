@@ -120,6 +120,13 @@ class FleetSim:
         self._available_fraction_fn = available_fraction_fn
         self.history: list[dict] = []
         self.tracer = telemetry.Tracer(process="fleetsim", enabled=False)
+        # Per-device health feed (telemetry/health.py): the simulated
+        # fleet attributes its injected faults to devices exactly like
+        # the socket planes attribute real ones.  Off by default.
+        self.health = None
+        if config.run.health_dir:
+            self.health = telemetry.HealthLedger(config.run.health_dir,
+                                                 "fleetsim")
 
         # CompileTracker on every jitted program makes the "one compile
         # per sweep shape" claim a measurable invariant (compile_counts
@@ -369,19 +376,31 @@ class FleetSim:
         if plan is None:
             return keep, trains, uplink, lost_ms, stats
         for j in range(n):
-            fired = plan.match(str(int(ids[j])), round_idx, "train",
+            did = str(int(ids[j]))
+            fired = plan.match(did, round_idx, "train",
                                kinds=_FLEET_FAULT_KINDS, site="server")
             for f in fired:
                 _count_fault(f.kind)
                 if f.kind == "drop_request":
                     keep[j] = uplink[j] = trains[j] = False
                     stats["dropped"] += 1
+                    if self.health is not None:
+                        self.health.record(did, round=round_idx,
+                                           deadline_miss=1)
                 elif f.kind == "delay":
                     lost_ms[j] += f.ms
                     stats["straggled"] += 1
+                    if self.health is not None:
+                        # The injected delay IS this device's observed
+                        # extra latency in the simulated plane.
+                        self.health.record(did, round=round_idx,
+                                           latency_s=f.ms / 1000.0)
                 elif f.kind == "corrupt_payload":
                     keep[j] = False
                     stats["corrupted"] += 1
+                    if self.health is not None:
+                        self.health.record(did, round=round_idx,
+                                           corrupt_frame=1)
         return keep, trains, uplink, lost_ms, stats
 
     # ------------------------------------------------------------- round --
@@ -475,6 +494,11 @@ class FleetSim:
             out["available_fraction"] = frac
             reg.gauge("fleetsim.available_fraction").set(frac)
         out["round_time_s"] = time.perf_counter() - t0
+        if self.health is not None:
+            # Durable once per round; health_* keys only when the plane
+            # is on (default records stay byte-identical).
+            self.health.flush()
+            out.update(telemetry.health_record_keys(self.health.devices()))
         reg.counter("fleetsim.rounds_total").inc()
         reg.counter("fleetsim.clients_trained_total").inc(n_trained)
         reg.counter("fleetsim.bytes_down_est_total").inc(bytes_down)

@@ -14,7 +14,9 @@ Public surface:
   detection, AOT cost analysis, HBM gauges) and live export (Prometheus
   endpoint, JSONL event stream, ``colearn top`` renderer);
 - :mod:`.flight` — crash flight recorder (heartbeat ring-buffer dumps,
-  ``colearn postmortem`` merge with the round WAL).
+  ``colearn postmortem`` merge with the round WAL);
+- :mod:`.health` — durable per-device health ledger (straggler
+  attribution, latency sketches, ``colearn health`` renderer).
 """
 
 from colearn_federated_learning_tpu.telemetry.tracer import (  # noqa: F401
@@ -50,6 +52,15 @@ from colearn_federated_learning_tpu.telemetry.runtime import (  # noqa: F401
     compiled_cost,
     prometheus_text,
     sample_device_memory,
+)
+from colearn_federated_learning_tpu.telemetry.health import (  # noqa: F401
+    DeviceHealth,
+    HealthLedger,
+    export_gauges,
+    feed_transport_retries,
+    health_record_keys,
+    load_health,
+    render_health,
 )
 from colearn_federated_learning_tpu.telemetry.flight import (  # noqa: F401
     FlightRecorder,

@@ -77,6 +77,15 @@ class Gauge:
         self.value = float(v)
 
 
+class _ChildGauge(Gauge):
+    """Labeled gauge child (``comm.agg_heartbeat_age_s{agg=0}``).  Unlike
+    counters there is no meaningful aggregate roll-up — a gauge is
+    last-observed, and "last across labels" is noise — so the parent is
+    left untouched and exists only to reserve the family name/kind."""
+
+
+
+
 class Histogram:
     """Streaming distribution summary with bounded memory.
 
@@ -130,6 +139,22 @@ class Histogram:
         return out
 
 
+class _ChildHistogram(Histogram):
+    """Labeled histogram child (``comm.agg_fold_time_s{agg=0}``): every
+    observation also lands in the unlabeled parent, so aggregate readers
+    (render_top's latency lines, SLO gates over the family) keep working
+    while the exposition additionally shows per-label quantiles."""
+
+    def __init__(self, name: str, parent: Histogram,
+                 max_samples: int = 8192):
+        super().__init__(name, max_samples=max_samples)
+        self._parent = parent
+
+    def observe(self, v: Number) -> None:
+        super().observe(v)
+        self._parent.observe(v)
+
+
 class MetricsRegistry:
     """Named instruments, created on first touch (prometheus-client
     idiom without the dependency).  Asking for an existing name with a
@@ -178,11 +203,46 @@ class MetricsRegistry:
                 )
             return inst
 
-    def gauge(self, name: str) -> Gauge:
-        return self._get(name, Gauge)
+    def gauge(self, name: str, labels: Optional[dict] = None) -> Gauge:
+        """Without ``labels``, the plain gauge.  With ``labels``, the
+        child registered under ``name{k=v,...}``; no aggregate roll-up
+        (a last-observed value has no meaningful sum across labels)."""
+        parent = self._get(name, Gauge)
+        if not labels:
+            return parent
+        full = labeled_name(name, labels)
+        with self._lock:
+            inst = self._instruments.get(full)
+            if inst is None:
+                inst = self._instruments[full] = _ChildGauge(full)
+            elif not isinstance(inst, Gauge):
+                raise TypeError(
+                    f"metric {full!r} is a {type(inst).__name__}, "
+                    "not a Gauge"
+                )
+            return inst
 
-    def histogram(self, name: str, max_samples: int = 8192) -> Histogram:
-        return self._get(name, Histogram, max_samples=max_samples)
+    def histogram(self, name: str, labels: Optional[dict] = None,
+                  max_samples: int = 8192) -> Histogram:
+        """Without ``labels``, the (aggregate) histogram.  With
+        ``labels``, the child registered under ``name{k=v,...}`` whose
+        observations also roll up into the aggregate (_ChildHistogram),
+        mirroring the labeled-counter contract."""
+        parent = self._get(name, Histogram, max_samples=max_samples)
+        if not labels:
+            return parent
+        full = labeled_name(name, labels)
+        with self._lock:
+            inst = self._instruments.get(full)
+            if inst is None:
+                inst = self._instruments[full] = _ChildHistogram(
+                    full, parent, max_samples=max_samples)
+            elif not isinstance(inst, Histogram):
+                raise TypeError(
+                    f"metric {full!r} is a {type(inst).__name__}, "
+                    "not a Histogram"
+                )
+            return inst
 
     def snapshot(self) -> dict:
         """Flat JSON-safe dump: counters/gauges map to their value,

@@ -251,16 +251,24 @@ def prometheus_text(typed_snapshot: dict) -> str:
         if kind == "histogram":
             lines.append(f"# TYPE {pname} summary")
             for labels, summary in families[base]["samples"]:
+                # A labeled child merges its labels into each quantile
+                # line and suffixes _count/_sum, sharing the family of
+                # the unlabeled aggregate parent.
+                extra = ""
                 if labels is not None:
-                    continue          # histograms are unlabeled today
+                    extra = _prom_labels(labels)[1:-1]  # inner k="v" pairs
                 for q, key in (("0.5", "p50"), ("0.9", "p90"),
                                ("0.99", "p99")):
                     if summary.get(key) is not None:
+                        qlabels = f'quantile="{q}"' + (
+                            f",{extra}" if extra else "")
                         lines.append(
-                            f'{pname}{{quantile="{q}"}} '
+                            f'{pname}{{{qlabels}}} '
                             f'{summary[key]:.10g}')
-                lines.append(f"{pname}_count {summary['count']}")
-                lines.append(f"{pname}_sum {summary['sum']:.10g}")
+                suffix = "{" + extra + "}" if extra else ""
+                lines.append(f"{pname}_count{suffix} {summary['count']}")
+                lines.append(
+                    f"{pname}_sum{suffix} {summary['sum']:.10g}")
             continue
         samples = [(labels, value)
                    for labels, value in families[base]["samples"]
@@ -428,6 +436,38 @@ def render_top(snapshot: dict, prev: Optional[dict] = None,
                         ("  reconnect fails  ",
                          "comm.reconnect_failures_total")):
         lines.append(f"{label}{val(name):>12.0f}")
+    # Aggregator tier: shown only when a tree is (or was) enrolled —
+    # per-agg rows come from the coordinator-side labeled children
+    # (heartbeat age gauge, slice-size gauge, partials-folded counter).
+    agg_rows: dict[str, dict] = {}
+    for name, v in snapshot.items():
+        m = _LABELED_RE.match(name)
+        if not m or v is None or isinstance(v, dict):
+            continue
+        base, labels = m.group("base"), m.group("labels")
+        field = {"comm.agg_heartbeat_age_s": "hb_age",
+                 "comm.agg_slice_devices": "slice",
+                 "comm.agg_partials_folded_total": "partials"}.get(base)
+        if field is None:
+            continue
+        agg = dict(item.partition("=")[::2] for item in labels.split(","))
+        agg_id = agg.get("agg")
+        if agg_id is None:
+            continue
+        agg_rows.setdefault(agg_id, {})[field] = float(v)
+    failovers = val("comm.agg_failovers_total")
+    expired = val("comm.agg_heartbeat_expired_total")
+    if agg_rows or failovers or expired:
+        lines.append("")
+        lines.append("aggregator tier")
+        for agg_id in sorted(agg_rows):
+            row = agg_rows[agg_id]
+            lines.append(
+                f"  agg {agg_id:<4} hb age {row.get('hb_age', 0.0):>7.2f}s"
+                f"   slice {row.get('slice', 0.0):>4.0f}"
+                f"   partials {row.get('partials', 0.0):>6.0f}")
+        lines.append(f"  failovers        {failovers:>12.0f}")
+        lines.append(f"  heartbeats expired{expired:>11.0f}")
     compiles = val("telemetry.compile_total")
     recompiles = val("telemetry.recompile_total")
     if compiles or recompiles:

@@ -291,6 +291,11 @@ class RunConfig:
     # heartbeat is older than this is treated as dead at dispatch and its
     # slices re-home to live siblings.
     agg_heartbeat_timeout: float = 5.0
+    # Per-device health ledger (telemetry/health.py): directory the
+    # coordinator/aggregator/fleetsim planes write durable straggler
+    # attribution into.  None = plane off, no extra I/O, and round
+    # records stay byte-identical to the pre-health format.
+    health_dir: Optional[str] = None
     # Deterministic fault injection (faults/): path to a FaultPlan JSON
     # installed as the transport interposer; None = no fault layer at all.
     fault_plan: Optional[str] = None

@@ -18,6 +18,19 @@ event stream — then:
   round;
 - feeds the flight dir through ``colearn postmortem``.
 
+A second **tree phase** then runs the same federation through a
+2-aggregator tier (``--num-aggregators 2``) with ``--trace-dir`` and
+``--health-dir`` opted in and asserts the fleet-health plane end to end:
+
+- the coordinator's Chrome trace holds ONE stitched round trace whose
+  spans cover all three tiers (coordinator -> aggregator-0/1 slice
+  folds -> worker train spans) with intact parent links;
+- the per-device health ledger is durable and non-empty (``colearn
+  health`` would render it);
+- the mid-run scrape carries LABELED histogram samples
+  (``fed_phase_time_s{phase=...}``) that satisfy the same exposition
+  grammar.
+
 Exit 0 only if every check passes.  This is the CI ``obs-smoke`` job;
 the SLO sentinel gate (``colearn sentinel``) runs as its own CI step.
 """
@@ -51,6 +64,123 @@ def _config_flags() -> list[str]:
             "--cohort-size", "0", "--local-steps", "2",
             "--batch-size", "16", "--min-cohort-fraction", "0.5",
             "--evict-after", "2", "--seed", "0"]
+
+
+def run_tree_phase(check, env: dict) -> None:
+    """2-aggregator federation: stitched trace + health ledger + labeled
+    histograms (the fleet-health plane's end-to-end contract)."""
+    workdir = tempfile.mkdtemp(prefix="colearn_obs_tree_")
+    trace_dir = os.path.join(workdir, "trace")
+    health_dir = os.path.join(workdir, "health")
+    cfg = _config_flags() + ["--health-dir", health_dir]
+    procs: list[subprocess.Popen] = []
+
+    def spawn(args: list[str], **kw) -> subprocess.Popen:
+        p = subprocess.Popen([sys.executable, "-m", _CLI, *args],
+                             env=env, **kw)
+        procs.append(p)
+        return p
+
+    try:
+        broker = spawn(["broker"], stdout=subprocess.PIPE, text=True)
+        addr = json.loads(broker.stdout.readline())
+        host, port = addr["host"], str(addr["port"])
+        for i in range(N_WORKERS):
+            log = open(os.path.join(workdir, f"worker{i}.log"), "ab")
+            spawn(["worker", *cfg, "--client-id", str(i),
+                   "--broker-host", host, "--broker-port", port],
+                  stdout=log, stderr=log)
+        for a in range(2):
+            log = open(os.path.join(workdir, f"aggregator{a}.log"), "ab")
+            spawn(["aggregator", *cfg, "--agg-id", str(a),
+                   "--broker-host", host, "--broker-port", port],
+                  stdout=log, stderr=log)
+        coord = spawn(
+            ["coordinate", *cfg, "--num-aggregators", "2",
+             "--trace-dir", trace_dir, "--metrics-port", "0",
+             "--broker-host", host, "--broker-port", port,
+             "--min-devices", str(N_WORKERS), "--round-timeout", "30",
+             "--enroll-timeout", "90", "--no-evaluator"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+
+        metrics_port = None
+        scraped = False
+        for line in coord.stderr:
+            try:
+                doc = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if doc.get("event") == "metrics_port":
+                metrics_port = int(doc["port"])
+            if "round" in doc and not scraped and metrics_port:
+                scraped = True
+                url = f"http://127.0.0.1:{metrics_port}/metrics"
+                text = urllib.request.urlopen(url, timeout=10) \
+                    .read().decode("utf-8")
+                lines = [ln for ln in text.splitlines() if ln]
+                bad = [ln for ln in lines if not _PROM_LINE.match(ln)]
+                check(not bad,
+                      f"tree scrape matches the exposition grammar "
+                      f"(bad: {bad[:3]})")
+                labeled_hist = [
+                    ln for ln in lines
+                    if ln.startswith("colearn_fed_phase_time_s{")
+                    and "quantile=" in ln and "phase=" in ln]
+                check(bool(labeled_hist),
+                      "scrape carries LABELED histogram samples "
+                      "(fed_phase_time_s{phase=...})")
+        rc = coord.wait(timeout=180)
+        check(rc == 0, f"tree coordinator exited 0 (got {rc})")
+
+        from colearn_federated_learning_tpu import telemetry
+
+        # One stitched round trace: coordinator, BOTH aggregator slice
+        # folds, and worker train spans, linked parent -> child.
+        traces = ([os.path.join(trace_dir, f)
+                   for f in sorted(os.listdir(trace_dir))
+                   if f.endswith("_trace.json")]
+                  if os.path.isdir(trace_dir) else [])
+        check(bool(traces), "tree run wrote a Chrome-trace JSON")
+        if traces:
+            spans = telemetry.trace_spans(telemetry.load_trace(traces[0]))
+            folds = [s for s in spans if s.name == "aggregator.fold"]
+            fold_aggs = {s.process for s in folds}
+            check(fold_aggs >= {"aggregator-0", "aggregator-1"},
+                  f"both aggregator slice folds in the trace "
+                  f"(got {sorted(fold_aggs)})")
+            trace_ids = {s.trace_id for s in folds}
+            stitched = False
+            for tid in trace_ids:
+                tier = [s for s in spans if s.trace_id == tid]
+                ids = {s.span_id for s in tier}
+                t_folds = [s for s in tier if s.name == "aggregator.fold"
+                           and s.parent_id in ids]
+                t_train = [s for s in tier if s.name == "worker.train"
+                           and s.parent_id in {f.span_id for f in t_folds}]
+                t_coord = [s for s in tier
+                           if s.process.startswith("coordinator")]
+                if len(t_folds) >= 2 and t_train and t_coord:
+                    stitched = True
+                    break
+            check(stitched,
+                  "one round trace stitches coordinator -> 2 aggregator "
+                  "folds -> worker train spans with parent links")
+
+        devices = telemetry.load_health(health_dir)
+        check(bool(devices),
+              f"health ledger non-empty ({len(devices)} device(s))")
+        check(any(h.lat_samples for h in devices.values()),
+              "health ledger attributes per-device round latency")
+        sources = (sorted(os.listdir(health_dir))
+                   if os.path.isdir(health_dir) else [])
+        check(any(s.startswith("health_aggregator") for s in sources),
+              f"aggregator tier fed the ledger (files: {sources})")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
 
 
 def main() -> int:
@@ -208,6 +338,8 @@ def main() -> int:
                 p.kill()
         for p in procs:
             p.wait()
+
+    run_tree_phase(check, env)
 
     if failures:
         print(f"[obs-smoke] {len(failures)} check(s) failed",

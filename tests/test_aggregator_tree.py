@@ -264,3 +264,77 @@ def test_tree_federation_secure_agg_exact(kx):
     # Masks do not cancel bitwise across regrouped sums — but they DO
     # cancel (a non-recovered mask would be O(1), not O(eps)).
     assert _max_diff(p_flat, p_tree) < 5e-4
+
+
+# ------------------------------------------------- fleet health plane ----
+def test_tree_trace_stitches_all_three_tiers():
+    """PR 12 tentpole: one round trace spans coordinator -> per-aggregator
+    slice fold -> worker train, with parent links intact across BOTH
+    process hops of the relay."""
+    import json
+
+    cfg = _config(3, 2)
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(3)]
+        aggs = [AggregatorServer(cfg, a, broker.host, broker.port).start()
+                for a in range(2)]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0)
+            coord.enroll(min_devices=3, timeout=20.0)
+            assert coord.enroll_aggregators(timeout=20.0)
+            rec = coord.run_round()
+            coord.close()
+        finally:
+            for a in aggs:
+                a.stop()
+            for w in workers:
+                w.stop()
+
+    spans = coord.tracer.snapshot()
+    round_sp = next(s for s in spans if s.name == "round")
+    ids = {s.span_id for s in spans}
+    # middle tier: one adopted fold span per aggregator, child of a
+    # coordinator span, same trace id
+    folds = [s for s in spans if s.name == "aggregator.fold"]
+    assert {s.process for s in folds} == {"aggregator-0", "aggregator-1"}
+    for f in folds:
+        assert f.trace_id == round_sp.trace_id
+        assert f.parent_id in ids
+    # leaf tier: every completed worker's train span rode two hops up
+    # and parents onto ITS aggregator's fold span
+    trains = [s for s in spans if s.name == "worker.train"]
+    assert len(trains) == rec["completed"]
+    fold_ids = {f.span_id for f in folds}
+    for t in trains:
+        assert t.trace_id == round_sp.trace_id
+        assert t.parent_id in fold_ids
+        assert t.process.startswith("worker-")
+    # per-tier phase timing landed in the round record; default records
+    # carry no health_* keys (byte-stability without --health-dir)
+    assert rec["phase_agg_fold_s"] > 0
+    assert not any(k.startswith("health_") for k in rec)
+    assert "trace_spans" not in json.dumps(rec)
+
+
+def test_tree_health_ledger_attributes_devices(tmp_path):
+    hdir = str(tmp_path / "health")
+    cfg = _config(3, 2, run_kw={"health_dir": hdir})
+    hist, _ = _run(cfg, 3)
+
+    devices = telemetry.load_health(hdir)
+    # the aggregator tier attributed observed round latency for every
+    # TRAINER (of 3 workers one enrolls as the evaluator, so 2 train)
+    assert len(devices) == 2
+    assert all(h.lat_samples for h in devices.values())
+    assert all(h.lat_ewma > 0 for h in devices.values())
+    # rollup keys stamped on the round records (only with the plane on)
+    assert hist[-1]["health_devices"] == 2
+    assert hist[-1]["health_lat_p99_s"] > 0
+    # a clean run has no offender: the worst-device key stays off (the
+    # same conditional-key convention as agg_failovers)
+    assert "health_worst_device" not in hist[-1]
+    # the renderer shows per-aggregator slice skew for a 2-agg tree
+    text = telemetry.render_health(devices)
+    assert "per-aggregator slice skew" in text

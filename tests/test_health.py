@@ -1,0 +1,214 @@
+"""telemetry/health.py: the per-device fleet health ledger — WAL-style
+durability (torn tail tolerated, mid-file corruption raises, compaction
+via atomic rewrite), EWMA + quantile-sketch latency, cross-source merge,
+the transport-retry feed, the `colearn health` renderer, labeled-gauge
+export, and the conditional round-record stamps."""
+
+import json
+import os
+
+import pytest
+
+from colearn_federated_learning_tpu.telemetry.health import (
+    DeviceHealth,
+    HealthLedger,
+    export_gauges,
+    feed_transport_retries,
+    health_record_keys,
+    load_health,
+    render_health,
+)
+from colearn_federated_learning_tpu.telemetry.registry import MetricsRegistry
+
+
+# --------------------------------------------------------- durability ----
+def test_record_flush_load_roundtrip(tmp_path):
+    led = HealthLedger(str(tmp_path), "coordinator")
+    led.record("3", round=0, latency_s=0.5)
+    led.record("3", round=1, latency_s=0.7, deadline_miss=1)
+    led.record("4", round=1, retry=2)
+    led.flush()
+    led.close()
+
+    devices = load_health(str(tmp_path))
+    assert set(devices) == {"3", "4"}
+    d3 = devices["3"]
+    assert d3.counts["deadline_miss"] == 1
+    assert d3.last_round == 1
+    assert d3.lat_samples == [0.5, 0.7]
+    assert devices["4"].counts["retry"] == 2
+
+
+def test_unflushed_events_visible_in_memory_not_on_disk(tmp_path):
+    led = HealthLedger(str(tmp_path), "coordinator")
+    led.record("1", round=0, latency_s=0.1)
+    assert "1" in led.devices()            # in-memory immediately
+    assert load_health(str(tmp_path)) == {}  # durable only after flush
+    led.flush()
+    assert set(load_health(str(tmp_path))) == {"1"}
+
+
+def test_unknown_count_field_raises(tmp_path):
+    led = HealthLedger(str(tmp_path), "coordinator")
+    with pytest.raises(ValueError, match="unknown health fields"):
+        led.record("1", deadline_mises=1)
+
+
+def test_torn_final_line_tolerated_mid_file_raises(tmp_path):
+    led = HealthLedger(str(tmp_path), "aggregator0")
+    led.record("1", round=0, latency_s=0.2)
+    led.record("2", round=0, latency_s=0.3)
+    led.flush()
+    led.close()
+
+    # SIGKILL mid-append: the torn FINAL line is the in-flight event —
+    # dropped on load, everything before it intact.
+    with open(led.path, "a") as f:
+        f.write('{"d":"9","round":1,"laten')
+    devices = load_health(str(tmp_path))
+    assert set(devices) == {"1", "2"}
+    # a fresh ledger replays the same file (same leniency)
+    led2 = HealthLedger(str(tmp_path), "aggregator0")
+    assert set(led2.devices()) == {"1", "2"}
+
+    # torn MID-file is corruption, not a crash artifact: raise.
+    lines = open(led.path).read().splitlines()
+    lines.insert(1, '{"d":"8","rou')
+    with open(led.path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="corrupt health ledger"):
+        load_health(str(tmp_path))
+
+
+def test_compaction_bounds_file_and_preserves_state(tmp_path):
+    reg = MetricsRegistry()
+    led = HealthLedger(str(tmp_path), "fleetsim", max_lines=8)
+    for r in range(20):
+        led.record(str(r % 3), round=r, latency_s=0.1 * (r % 3 + 1),
+                   retry=1)
+        led.flush()
+    led.close()
+
+    lines = [ln for ln in open(led.path).read().splitlines() if ln]
+    assert len(lines) <= 8 + 1             # bounded, not O(events)
+    assert any("snapshot" in json.loads(ln) for ln in lines[:1])
+
+    devices = load_health(str(tmp_path))
+    assert set(devices) == {"0", "1", "2"}
+    # every event survived the rewrites: 20 retries split 7/7/6
+    assert sum(d.counts["retry"] for d in devices.values()) == 20
+    # replay into a fresh ledger sees the compacted state too
+    led2 = HealthLedger(str(tmp_path), "fleetsim", max_lines=8)
+    assert sum(d.counts["retry"]
+               for d in led2.devices().values()) == 20
+
+
+# ------------------------------------------------------ sketch & merge ----
+def test_latency_ewma_and_sample_thinning():
+    dev = DeviceHealth("7")
+    for i in range(1000):
+        dev.apply({"latency_s": 1.0 + (i % 10) * 0.01, "round": i})
+    assert dev.lat_ewma == pytest.approx(1.045, abs=0.05)
+    assert len(dev.lat_samples) < 256      # stride-thinned, bounded
+    assert dev.rounds == 1000
+
+
+def test_merge_sums_counts_and_weights_ewma():
+    a = DeviceHealth("5")
+    for r in range(3):
+        a.apply({"round": r, "latency_s": 1.0, "deadline_miss": 1})
+    b = DeviceHealth("5")
+    b.apply({"round": 9, "latency_s": 4.0, "retry": 2})
+
+    a.merge(b)
+    assert a.counts["deadline_miss"] == 3 and a.counts["retry"] == 2
+    assert a.rounds == 4 and a.last_round == 9
+    # rounds-weighted: (3*1.0 + 1*4.0) / 4
+    assert a.lat_ewma == pytest.approx(1.75)
+    assert a.lat_samples == [1.0, 1.0, 1.0, 4.0]
+
+
+def test_load_health_merges_sources(tmp_path):
+    c = HealthLedger(str(tmp_path), "coordinator")
+    c.record("2", round=1, deadline_miss=1)
+    c.flush()
+    a = HealthLedger(str(tmp_path), "aggregator1")
+    a.record("2", round=1, latency_s=0.9, agg="1")
+    a.flush()
+
+    devices = load_health(str(tmp_path))
+    assert set(devices) == {"2"}
+    merged = devices["2"]
+    assert merged.counts["deadline_miss"] == 1
+    assert merged.lat_ewma == pytest.approx(0.9)
+    assert merged.agg == "1"
+
+
+# --------------------------------------------------------------- feeds ----
+def test_feed_transport_retries_attributes_deltas_once(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("comm.retry_total", labels={"device": "3"}).inc(2)
+    reg.counter("comm.retry_total", labels={"device": "agg:0"}).inc(5)
+
+    led = HealthLedger(str(tmp_path), "coordinator")
+    seen: dict = {}
+    feed_transport_retries(led, seen, registry=reg)
+    assert led.devices()["3"].counts["retry"] == 2
+    assert "agg:0" not in led.devices()    # non-device peers skipped
+
+    # no new retries -> no double count
+    feed_transport_retries(led, seen, registry=reg)
+    assert led.devices()["3"].counts["retry"] == 2
+    reg.counter("comm.retry_total", labels={"device": "3"}).inc()
+    feed_transport_retries(led, seen, registry=reg)
+    assert led.devices()["3"].counts["retry"] == 3
+
+
+# ----------------------------------------------------------- reporting ----
+def _fleet():
+    devices = {}
+    for did, agg, lat in (("0", "0", 0.2), ("1", "0", 0.25),
+                          ("2", "1", 1.2), ("3", "1", 1.1)):
+        dev = DeviceHealth(did)
+        for r in range(4):
+            dev.apply({"round": r, "latency_s": lat, "agg": agg})
+        devices[did] = dev
+    devices["2"].apply({"round": 4, "deadline_miss": 2, "eviction": 1})
+    return devices
+
+
+def test_render_health_sections():
+    text = render_health(_fleet())
+    assert "devices tracked" in text
+    assert "top offenders" in text
+    # offender score: 5*1 + 3*2 = 11, ranked first
+    first_row = text.splitlines()[6]
+    assert first_row.strip().startswith("2") and "11" in first_row
+    assert "straggler tail" in text and "p99" in text
+    assert "per-aggregator slice skew" in text
+    assert "skew (max/min mean)" in text
+    assert render_health({}).endswith("no health records found")
+
+
+def test_export_gauges_labeled_and_bounded():
+    reg = MetricsRegistry()
+    export_gauges(_fleet(), registry=reg, top=2)
+    snap = reg.snapshot()
+    assert snap["health.devices_tracked"] == 4
+    assert snap["health.device_score{device=2}"] == 11.0
+    assert "health.device_latency_ewma_s{device=2}" in snap
+    # bounded to the top-2 offenders — no per-device gauge explosion
+    assert "health.device_score{device=0}" not in snap
+
+
+def test_health_record_keys_conditional():
+    keys = health_record_keys(_fleet())
+    assert keys["health_devices"] == 4
+    assert keys["health_lat_p99_s"] == pytest.approx(1.2)
+    assert keys["health_worst_device"] == "2"
+    assert keys["health_worst_score"] == 11.0
+    # a clean fleet stamps no offender keys
+    clean = {k: v for k, v in _fleet().items() if k != "2"}
+    keys = health_record_keys(clean)
+    assert "health_worst_device" not in keys
+    assert keys["health_devices"] == 3

@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL013), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL014), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -738,6 +738,89 @@ def test_cl013_suppression(tmp_path):
                 delta, meta, shapes=self.shapes)
             return self.stage(dense)
     """, relpath="pkg/comm/aggregation.py", rules=["CL013"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl014_flags_raw_clock_delta_in_hot_wire_path(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+
+        def collect(self, devs):  # colearn: hot
+            t0 = time.perf_counter()
+            out = [self.ask(d) for d in devs]
+            dt = time.perf_counter() - t0
+            print("collected in", dt)
+            return out
+    """, relpath="pkg/comm/coordinator.py", rules=["CL014"])
+    assert rule_ids(res) == ["CL014"]
+    assert res.exit_code == 1
+
+
+def test_cl014_allows_attributed_deltas_and_deadline_math(tmp_path):
+    # Accumulation into a named stat (the StreamingFolder.fold_s idiom)
+    # is attributed — the delta lands in round meta.
+    res = run_lint(tmp_path, """
+        import time
+
+        def add(self, meta, delta):  # colearn: hot
+            t0 = time.perf_counter()
+            self.stage(meta, delta)
+            self.fold_s += time.perf_counter() - t0
+    """, relpath="pkg/comm/aggregation.py", rules=["CL014"])
+    assert res.findings == []
+    # A delta fed straight to a registry histogram is attributed.
+    res = run_lint(tmp_path, """
+        import time
+
+        def fold(self, reg, parts):  # colearn: hot
+            t0 = time.monotonic()
+            for p in parts:
+                self.merge(p)
+            reg.histogram("fed.phase_time_s").observe(
+                time.monotonic() - t0)
+    """, relpath="pkg/comm/aggregator.py", rules=["CL014"])
+    assert res.findings == []
+    # Deadline arithmetic keeps the clock on the RIGHT — budget
+    # bookkeeping, not an unattributed duration.
+    res = run_lint(tmp_path, """
+        import time
+
+        def wait(self, fut, deadline):  # colearn: hot
+            return fut.result(timeout=deadline - time.monotonic())
+    """, relpath="pkg/comm/transport.py", rules=["CL014"])
+    assert res.findings == []
+    # Cold comm path: eval/debug timing is not CL014's business.
+    res = run_lint(tmp_path, """
+        import time
+
+        def profile(self, devs):
+            t0 = time.time()
+            self.ping(devs)
+            return time.time() - t0
+    """, relpath="pkg/comm/coordinator.py", rules=["CL014"])
+    assert res.findings == []
+    # Hot raw delta OUTSIDE comm/: other planes keep their own idioms.
+    res = run_lint(tmp_path, """
+        import time
+
+        def step(batch):  # colearn: hot
+            t0 = time.perf_counter()
+            run(batch)
+            return time.perf_counter() - t0
+    """, relpath="pkg/fed/mod.py", rules=["CL014"])
+    assert res.findings == []
+
+
+def test_cl014_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+
+        def drain(self, q):  # colearn: hot
+            t0 = time.monotonic()
+            q.drain()
+            lag = time.monotonic() - t0  # colearn: noqa(CL014)
+            return lag
+    """, relpath="pkg/comm/worker.py", rules=["CL014"])
     assert res.findings == [] and res.suppressed == 1
 
 

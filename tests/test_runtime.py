@@ -220,3 +220,59 @@ def test_render_top_shows_counters_and_rates():
     assert "(25.0%)" in body           # 2G of 8G
     # Pure function: renders from an empty snapshot without crashing.
     assert "colearn top" in runtime.render_top({})
+
+
+# ------------------------------------------------- labeled instruments --
+def test_labeled_histogram_child_rolls_up_and_exposes():
+    reg = MetricsRegistry()
+    reg.histogram("fed.phase_time_s",
+                  labels={"phase": "agg_fold"}).observe(0.2)
+    reg.histogram("fed.phase_time_s",
+                  labels={"phase": "downlink"}).observe(0.4)
+    # every child observation also lands in the unlabeled aggregate, so
+    # render_top latency lines and family-level SLO gates keep working
+    assert reg.histogram("fed.phase_time_s").count == 2
+    assert reg.histogram(
+        "fed.phase_time_s", labels={"phase": "agg_fold"}).count == 1
+
+    text = runtime.prometheus_text(reg.typed_snapshot())
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    # one family: TYPE once, children keyed by merged label sets
+    assert text.count("# TYPE colearn_fed_phase_time_s summary") == 1
+    assert ('colearn_fed_phase_time_s'
+            '{quantile="0.5",phase="agg_fold"} 0.2') in text
+    assert 'colearn_fed_phase_time_s_count{phase="agg_fold"} 1' in text
+    assert 'colearn_fed_phase_time_s_sum{phase="downlink"} 0.4' in text
+    assert "colearn_fed_phase_time_s_count 2" in text  # the aggregate
+
+
+def test_labeled_gauge_child_does_not_roll_up():
+    reg = MetricsRegistry()
+    reg.gauge("health.device_score", labels={"device": "2"}).set(11)
+    snap = reg.snapshot()
+    assert snap["health.device_score{device=2}"] == 11.0
+    # "last across labels" is noise: the parent gauge stays unset and
+    # out of the exposition
+    text = runtime.prometheus_text(reg.typed_snapshot())
+    assert 'colearn_health_device_score{device="2"} 11' in text
+    assert "\ncolearn_health_device_score 1" not in text
+
+
+def test_render_top_aggregator_tier_section():
+    snap = {"fed.rounds_total": 4,
+            "comm.agg_heartbeat_age_s{agg=0}": 0.8,
+            "comm.agg_heartbeat_age_s{agg=1}": 12.5,
+            "comm.agg_slice_devices{agg=0}": 3,
+            "comm.agg_slice_devices{agg=1}": 2,
+            "comm.agg_partials_folded_total{agg=0}": 12,
+            "comm.agg_failovers_total": 1}
+    body = runtime.render_top(snap)
+    assert "aggregator tier" in body
+    agg0 = next(ln for ln in body.splitlines() if "agg 0" in ln)
+    assert "hb age" in agg0 and "0.80s" in agg0
+    assert "slice    3" in agg0 and "partials     12" in agg0
+    assert "failovers" in body
+    # flat runs keep the old layout: no tier section at all
+    assert "aggregator tier" not in runtime.render_top(
+        {"fed.rounds_total": 4})

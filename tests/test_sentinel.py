@@ -215,3 +215,105 @@ def test_repo_slo_rules_hold_against_committed_results():
         pytest.skip("no tomllib/tomli available")
     verdict = sentinel.evaluate_slo(root, rules=rules)
     assert verdict["ok"], verdict["results"]
+
+
+# ------------------------------------------------------- window rules --
+def _round_rows(values):
+    return [{"round": r, "phase_agg_fold_s": v}
+            for r, v in enumerate(values)]
+
+
+def test_window_rule_fires_on_injected_latency_regression(tmp_path,
+                                                          capsys):
+    """The tentpole acceptance fixture: 20 healthy rounds of fold
+    latency, then 5 rounds at 2.5x — the trailing-p99 / baseline-median
+    ratio must trip the 1.5x band, in the API and through the CLI."""
+    write_rows(tmp_path / "results" / "rounds.jsonl",
+               _round_rows([0.10] * 20 + [0.25] * 5))
+    rule = sentinel.WindowRule(
+        id="fold-p99", file="results/rounds.jsonl",
+        field="phase_agg_fold_s", window=5, baseline=20,
+        agg="p99", baseline_agg="median", max_ratio=1.5)
+    res = rule.evaluate(str(tmp_path))
+    assert not res["ok"]
+    assert res["value"] == pytest.approx(2.5)
+    assert res["reason"].startswith("above_max_ratio:2.5")
+    assert res["window_value"] == pytest.approx(0.25)
+    assert res["baseline_value"] == pytest.approx(0.10)
+
+    write_rules(tmp_path, """
+[[tool.colearn.slo.rules]]
+id = "fold-p99"
+file = "results/rounds.jsonl"
+field = "phase_agg_fold_s"
+window = 5
+baseline = 20
+max_ratio = 1.5
+""")
+    rc = cli_main(["sentinel", "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "above_max_ratio" in out and "VIOLATION" in out
+
+
+def test_window_rule_steady_state_stays_green(tmp_path):
+    jitter = [0.10 + (r % 4) * 0.002 for r in range(25)]
+    write_rows(tmp_path / "results" / "rounds.jsonl",
+               _round_rows(jitter))
+    rule = sentinel.WindowRule(
+        id="fold-p99", file="results/rounds.jsonl",
+        field="phase_agg_fold_s", window=5, baseline=20,
+        agg="p99", baseline_agg="median", max_ratio=1.5)
+    res = rule.evaluate(str(tmp_path))
+    assert res["ok"] and res["reason"] is None
+    assert res["value"] == pytest.approx(0.106 / 0.104)
+
+
+def test_window_rule_is_stable_under_row_reordering(tmp_path):
+    """Rows sort by ``order_by`` before windowing: merged shards or
+    re-appended history cannot move rounds between window and baseline."""
+    rows = _round_rows([0.10] * 20 + [0.25] * 5)
+    rule = sentinel.WindowRule(
+        id="fold-p99", file="results/rounds.jsonl",
+        field="phase_agg_fold_s", window=5, baseline=20,
+        agg="p99", max_ratio=1.5)
+    write_rows(tmp_path / "results" / "rounds.jsonl", rows)
+    forward = rule.evaluate(str(tmp_path))
+    shuffled = rows[1::2] + rows[0::2][::-1]
+    write_rows(tmp_path / "results" / "rounds.jsonl", shuffled)
+    assert rule.evaluate(str(tmp_path)) == forward
+
+
+def test_window_rule_insufficient_history(tmp_path):
+    write_rows(tmp_path / "results" / "rounds.jsonl",
+               _round_rows([0.1] * 10))
+    rule = sentinel.WindowRule(
+        id="r", file="results/rounds.jsonl", field="phase_agg_fold_s",
+        window=5, baseline=20, max_ratio=1.5)
+    res = rule.evaluate(str(tmp_path))
+    assert not res["ok"]
+    assert res["reason"] == "insufficient_rows:10<25"
+    # a short clean run can opt out of strictness
+    rule.allow_missing = True
+    assert rule.evaluate(str(tmp_path))["ok"]
+
+
+def test_window_rule_validation_and_dispatch():
+    table = {"id": "w", "file": "f", "field": "x", "window": 5,
+             "max_ratio": 1.5}
+    assert isinstance(sentinel.rule_from_table(table),
+                      sentinel.WindowRule)
+    assert isinstance(
+        sentinel.rule_from_table({"id": "s", "file": "f", "field": "x",
+                                  "agg": "mean", "min": 0}),
+        sentinel.SloRule)
+    with pytest.raises(ValueError, match="max_ratio and/or min_ratio"):
+        sentinel.WindowRule(id="w", file="f", field="x", window=5,
+                            baseline=20)
+    with pytest.raises(ValueError, match="not in"):
+        sentinel.WindowRule(id="w", file="f", field="x", window=5,
+                            baseline=20, agg="last", max_ratio=1.5)
+    with pytest.raises(ValueError, match="unknown keys"):
+        sentinel.WindowRule.from_table(
+            {"id": "w", "file": "f", "field": "x", "window": 5,
+             "max_ratio": 1.5, "threshold": 2})
