@@ -94,9 +94,15 @@ COUNTERS = (
     "async.dispatch_failures",
     "async.aggregations_total",
     "async.updates_discarded_stale",
+    "async.devices_pruned_total",      # labeled {reason=straggler|...}
+    "async.devices_readmitted_total",  # probation expiry re-admissions
+    "fed.devices_evicted_total",       # dead-pump eviction, labeled {device=}
     # fleet simulation (fleetsim/sim.py)
     "fleetsim.rounds_total",
     "fleetsim.clients_trained_total",
+    "fleetsim.async_aggregations_total",
+    "fleetsim.async_updates_discarded_total",  # too-stale at fold time
+    "fleetsim.async_devices_pruned_total",
     "fleetsim.bytes_up_est_total",     # wire-codec frame estimate, uplink
     "fleetsim.bytes_down_est_total",   # wire-codec frame estimate, downlink
     "fleetsim.bytes_gather_avoided_est_total",  # sharded-downlink estimate
@@ -116,6 +122,8 @@ GAUGES = (
     "fleetsim.devices",
     "fleetsim.chunk_size",
     "fleetsim.available_fraction",
+    "fleetsim.async_buffer_size",
+    "fleetsim.async_sim_minutes",   # simulated-clock minutes elapsed
     # sharded server: measured per-chip server-state bytes (per-shard
     # accounting via parallel/partition.bytes_per_chip — deterministic
     # even where memory_stats() is empty)

@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL014).
+"""The colearn rule set (CL001–CL015).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -887,3 +887,44 @@ class UnattributedTimingInHotWirePath(Rule):
                     "duration no sink ever sees; route it through a "
                     "tracer span or a registry histogram so the health "
                     "plane can attribute it")
+
+
+# ----------------------------------------------------------------- CL015 --
+@register
+class UninterruptibleBackoffSleep(Rule):
+    """A bare ``time.sleep()`` inside a comm retry/dispatch loop is an
+    uninterruptible stall: ``close()``/``stop()`` cannot wake the thread,
+    so shutdown blocks for a full backoff (and the chaos gate's SIGKILL
+    relaunch inherits a zombie that finishes its nap before noticing the
+    socket died).  Every backoff in the comm plane waits on a
+    ``threading.Event`` (``self._stop.wait(delay)``/``_closing.wait``)
+    instead — same delay when idle, immediate wakeup on teardown.  Sleeps
+    outside loops (test fixtures, one-shot startup grace) are not
+    backoffs and stay clean."""
+
+    id = "CL015"
+    title = "uninterruptible time.sleep() in a comm retry/dispatch loop"
+    hint = ("wait on the owner's stop event instead: "
+            "`if self._stop.wait(delay): return` wakes on shutdown; "
+            "mark a justified bare sleep with `# colearn: noqa(CL015)`")
+
+    _SLEEPS = {"time.sleep", "sleep"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dir("comm"):
+            return
+        loops = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.For, ast.While))]
+        in_loop: set = set()
+        for loop in loops:
+            in_loop.update(id(n) for n in ast.walk(loop))
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and id(node) in in_loop):
+                continue
+            if dotted_name(node.func) not in self._SLEEPS:
+                continue
+            yield self.finding(
+                ctx, node,
+                "bare time.sleep() in a retry/dispatch loop cannot be "
+                "interrupted by close()/stop(): the backoff outlives "
+                "teardown; wait on the stop Event so shutdown wakes it")

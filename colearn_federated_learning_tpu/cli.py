@@ -85,8 +85,9 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--secure-agg-neighbors", type=int, default=None,
                    help="k-regular random-ring masking (0 = all pairs)")
     p.add_argument("--compress", default=None,
-                   choices=["none", "int8", "topk"],
-                   help="update compression on the wire/file planes")
+                   choices=["none", "int8", "topk", "topk8"],
+                   help="update compression on the wire/file planes "
+                        "(topk8: int8 values inside the topk frame)")
     p.add_argument("--compress-feedback", action="store_true", default=None,
                    help="carry the uplink compression residual into the "
                         "next round's delta (error feedback; rejected "
@@ -586,6 +587,9 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             request_timeout=args.round_timeout,
             want_evaluator=not args.no_evaluator,
             mud_policy=mud_policy,
+            prune_after=args.async_prune_after,
+            prune_score=args.async_prune_score,
+            probation=args.async_probation,
         )
         if recorder is not None:
             recorder.attach_tracer(coord.tracer)
@@ -654,6 +658,43 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print("--agg is its own multi-process gate; drop --secure/--mp",
               file=sys.stderr)
         return 2
+    if args.chaos_async and (args.secure or args.mp or args.agg):
+        print("--async is its own multi-process gate; "
+              "drop --secure/--mp/--agg", file=sys.stderr)
+        return 2
+    if args.chaos_async:
+        from colearn_federated_learning_tpu.faults import procsoak
+
+        summary = procsoak.run_async_soak(
+            aggregations=args.rounds, n_workers=args.num_workers,
+            workdir=args.workdir, round_timeout=args.mp_round_timeout,
+            timeout_s=args.mp_timeout, kill=not args.no_faults,
+            log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+        )
+        print(json.dumps(summary))
+        ok = (summary["exit_code"] == 0
+              and summary["baseline_exit_code"] == 0
+              and summary["aggregations_run"] >= args.rounds
+              and summary["baseline_aggregations_run"] >= args.rounds
+              # The three async-plane invariants a lost buffer must not
+              # break: per-incarnation version monotonicity, an RDP
+              # budget that replays to the recorded epsilon (no
+              # double-charge through --resume), and a tail loss within
+              # tolerance of the same-seed kill-free baseline.
+              and summary["version_monotonic"]
+              and summary["dp_replay_ok"]
+              and summary["loss_gap_ok"]
+              and summary["health_ledger_ok"]
+              # With the kill armed the gate must have EXERCISED the
+              # recovery: a real resume, a postmortem naming the victim,
+              # its flight dump on disk, and the injected pump faults
+              # attributed in the health ledger.
+              and (args.no_faults
+                   or (summary["resumed"] >= 1
+                       and summary["postmortem_attributed"]
+                       and summary["faults_attributed"]
+                       and not summary["flight_missing"])))
+        return 0 if ok else 1
     if args.agg:
         from colearn_federated_learning_tpu.faults import procsoak
 
@@ -809,6 +850,33 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
         chunk_size=args.chunk, fault_plan=plan)
     if args.trace_dir:
         sim.tracer.enabled = True
+    if args.async_buffer:
+        history = sim.fit_async(
+            args.rounds, buffer_size=args.async_buffer,
+            max_staleness=args.async_max_staleness,
+            prune_after=args.async_prune_after,
+            probation=args.async_probation,
+            log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr))
+        last = history[-1]
+        summary = {
+            "devices": spec.num_devices,
+            "buffer_size": args.async_buffer,
+            "aggregations": len(history),
+            "model_version": last["model_version"],
+            "sim_minutes": last["sim_time_min"],
+            "arrival_rate_per_min": last["arrival_rate_per_min"],
+            "agg_rate_per_min": last["agg_rate_per_min"],
+            "staleness_mean": (
+                sum(r["staleness_mean"] for r in history) / len(history)),
+            "wasted_updates": last["wasted_updates_total"],
+            "train_loss": last["train_loss"],
+            "compiles": sim.compile_counts,
+        }
+        if args.async_prune_after:
+            summary["pruned"] = last["pruned"]
+            summary["pruned_total"] = last["pruned_total"]
+        print(json.dumps(summary))
+        return 0 if history and last["model_version"] > 0 else 1
     history = sim.fit(
         args.rounds,
         log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr))
@@ -1151,6 +1219,18 @@ def main(argv: list[str] | None = None) -> int:
                               "aggregation (FedBuff-style): apply the "
                               "staleness-weighted mean every N updates "
                               "instead of running synchronous rounds")
+    p_coord.add_argument("--async-prune-after", type=int, default=0,
+                         help="pause a device's dispatch pump after this "
+                              "many CONSECUTIVE too-stale discards "
+                              "(straggler pruning; needs --health-dir)")
+    p_coord.add_argument("--async-prune-score", type=float, default=0.0,
+                         help="pause pumps whose health-ledger score "
+                              "(failure weights + latency-vs-median "
+                              "term) reaches this; 0 disables "
+                              "(needs --health-dir)")
+    p_coord.add_argument("--async-probation", type=int, default=8,
+                         help="aggregations a pruned device sits out "
+                              "before probation re-admits its pump")
     _add_observability_flags(p_coord)
     p_coord.set_defaults(fn=cmd_coordinate)
 
@@ -1191,6 +1271,15 @@ def main(argv: list[str] | None = None) -> int:
                               "aggregator SIGKILLed mid-round, final "
                               "params lockstep vs a flat oracle run "
                               "(faults/procsoak.run_agg_soak)")
+    p_chaos.add_argument("--async", dest="chaos_async",
+                         action="store_true",
+                         help="buffered-async chaos gate: broker/workers/"
+                              "async coordinator as real subprocesses, "
+                              "SIGKILL mid-aggregation + --resume "
+                              "relaunch; gates version monotonicity, "
+                              "accountant replay, and final loss vs a "
+                              "same-seed kill-free async run "
+                              "(faults/procsoak.run_async_soak)")
     p_chaos.add_argument("--workdir", default=None,
                          help="--mp scratch dir for checkpoints + process "
                               "logs (default: a fresh temp dir)")
@@ -1232,7 +1321,7 @@ def main(argv: list[str] | None = None) -> int:
     p_fleet.add_argument("--hidden-dim", type=int, default=64)
     p_fleet.add_argument("--depth", type=int, default=2)
     p_fleet.add_argument("--compress", default="none",
-                         choices=["none", "int8", "topk"],
+                         choices=["none", "int8", "topk", "topk8"],
                          help="uplink scheme for the byte estimates")
     p_fleet.add_argument("--compress-down", default="none",
                          choices=["none", "int8", "topk"])
@@ -1245,6 +1334,22 @@ def main(argv: list[str] | None = None) -> int:
                          help="write the sweep's span trace (fleet_round/"
                               "train_chunks/train_chunk) as a Chrome-trace "
                               "JSON here; read with `colearn trace-summary`")
+    p_fleet.add_argument("--async-buffer", type=int, default=0,
+                         help="> 0 runs the buffered-ASYNC simulation "
+                              "instead of sync rounds: fold every N "
+                              "arrival-ordered completions with staleness "
+                              "weighting (FleetSim.fit_async); --rounds "
+                              "then counts aggregations")
+    p_fleet.add_argument("--async-max-staleness", type=int, default=10,
+                         help="async mode: discard updates staler than "
+                              "this many versions (wasted compute)")
+    p_fleet.add_argument("--async-prune-after", type=int, default=0,
+                         help="async mode: stop re-dispatching a device "
+                              "after this many CONSECUTIVE too-stale "
+                              "discards (0 = off)")
+    p_fleet.add_argument("--async-probation", type=int, default=8,
+                         help="async mode: aggregations a pruned device "
+                              "sits out before re-admission")
     p_fleet.set_defaults(fn=cmd_fleetsim)
 
     p_lint = sub.add_parser("lint",

@@ -152,10 +152,11 @@ class StreamingFolder(UpdateFolder):
             raise RuntimeError("StreamingFolder already finalized")
         t0 = time.perf_counter()
         w = float(meta.get("weight", 1.0)) if weight is None else float(weight)
-        if meta.get("compress") == "topk":
+        if meta.get("compress") in compression.TOPK_SCHEMES:
             # Sparse-native staging: the wire's (indices, values) stay
             # sparse — O(k) copy + scale here, cohort-order scatter-add at
-            # finalize.  No full-shape tensor is materialized per update.
+            # finalize (topk8 values dequantize inside topk_leaf_arrays).
+            # No full-shape tensor is materialized per update.
             contrib = self._stage_topk(delta, w)
             self.densify_avoided += 1
             telemetry.get_registry().counter(

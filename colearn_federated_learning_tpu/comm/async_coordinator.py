@@ -31,11 +31,25 @@ claim: buffer membership is availability-ordered), and discarded updates
 charge nothing (never released).  Restore replays each record's charged
 multiplier.  ``secure_agg`` stays synchronous-only (masks need an agreed
 per-round cohort), as does adaptive clipping (cross-round engine state).
+
+Health-driven straggler pruning (CLIP lineage — arXiv 2510.16694,
+PAPERS.md): with a health ledger attached (``run.health_dir``) every
+dispatch outcome is attributed per device — observed latency on success,
+a retry count on failure, a deadline miss on every ``max_staleness``
+discard — and the coordinator scores devices from that ledger plus its
+own consecutive-too-stale streaks.  A chronic straggler's updates are
+predestined for the staleness discard, so its pump is PAUSED (a pruned
+client is a predicted dropout that stops burning device compute) and
+re-admitted after a probation window of aggregations.  Pruning never
+shrinks the active pump set below ``buffer_size`` (the buffer must stay
+fillable), and all of it is off — with byte-identical aggregation
+records — unless explicitly enabled.
 """
 
 from __future__ import annotations
 
 import math
+import os
 import queue
 import threading
 import time
@@ -74,9 +88,27 @@ class AsyncFederatedCoordinator:
         request_timeout: float = 60.0,
         want_evaluator: bool = True,
         mud_policy=None,
+        prune_after: int = 0,
+        prune_score: float = 0.0,
+        probation: int = 8,
     ):
+        """``prune_after``: consecutive too-stale discards before a
+        device's pump is paused (0 disables streak pruning).
+        ``prune_score``: health-ledger score threshold that pauses a pump
+        (0 disables score pruning).  ``probation``: aggregations a pruned
+        device sits out before re-admission.  Either pruning trigger
+        requires ``run.health_dir`` — the ledger is the score source."""
         if buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        if prune_after < 0 or prune_score < 0:
+            raise ValueError("prune_after/prune_score must be >= 0")
+        if probation < 1:
+            raise ValueError(f"probation must be >= 1, got {probation}")
+        if (prune_after or prune_score) and not config.run.health_dir:
+            raise ValueError(
+                "straggler pruning scores devices from the health ledger; "
+                "set run.health_dir (--health-dir) to enable it"
+            )
         if config.fed.dp_adaptive_clip:
             raise NotImplementedError(
                 "dp_adaptive_clip is engine-only (stateless socket "
@@ -115,7 +147,35 @@ class AsyncFederatedCoordinator:
                                     timeout=protocol.CONNECT_TIMEOUT)
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy)
         params = setup_lib.init_global_params(config)
+        # Sharded server (PR 9): with run.tp_size > 1 the global model and
+        # the streaming fold live sharded over a local (model,) mesh —
+        # same placement seam as the synchronous coordinator, same
+        # counted fallback when the host cannot honor tp_size.
+        from colearn_federated_learning_tpu.parallel import (
+            partition as partition_lib,
+        )
+
+        self._placement = partition_lib.make_server_placement(
+            params, config.run.tp_size, config.run.tp_axis,
+            config.model.name,
+        )
+        if self._placement is not None:
+            params = self._placement.shard(params)
+            self._shapes_np = self._placement.shapes_tree()
+        else:
+            # Zero-memory shape/dtype stand-in (read-only broadcast
+            # views) for folder construction.
+            self._shapes_np = jax.tree.map(
+                lambda a: np.broadcast_to(
+                    np.zeros((), np.dtype(getattr(a, "dtype", np.float32))),
+                    np.shape(a)),
+                params,
+            )
         self.server_state = strategies.init_server_state(params, config.fed)
+        if self._placement is not None:
+            telemetry.get_registry().gauge(
+                "comm.server_bytes_per_chip").set(
+                    partition_lib.bytes_per_chip(self.server_state))
         self.version = 0                       # server model version t
         self.history: list[dict] = []
         self.trainers: list[DeviceInfo] = []
@@ -127,11 +187,39 @@ class AsyncFederatedCoordinator:
         self._snap_cache: Optional[tuple] = None
         self._state_lock = threading.Lock()
         self._version_cv = threading.Condition()
+        self._cv_poll_s = 0.1
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.failures: dict[str, int] = {}
         self._ckpt = None
         self.tracer = telemetry.Tracer(process="async-coordinator")
+        # Per-device health ledger (telemetry/health.py): durable
+        # straggler attribution fed from the dispatcher pumps (latency on
+        # success, retries on failure) and the aggregator (staleness
+        # discards as deadline misses).  Gated on run.health_dir; the
+        # pump threads share one ledger, hence the lock.
+        self.health = None
+        self._health_lock = threading.Lock()
+        self._health_retry_seen: dict[str, float] = {}
+        if config.run.health_dir:
+            self.health = telemetry.HealthLedger(config.run.health_dir,
+                                                 "async-coordinator")
+        # Straggler pruning state (see module docstring): paused pumps
+        # keyed by device -> aggregation index at which probation ends.
+        self.prune_after = int(prune_after)
+        self.prune_score = float(prune_score)
+        self.probation = int(probation)
+        self.prune_enabled = bool(prune_after or prune_score)
+        self._pruned: dict[str, int] = {}
+        self._stale_streak: dict[str, int] = {}
+        # Dead-pump eviction (RunConfig.evict_after): a pump whose device
+        # fails this many CONSECUTIVE dispatches stops and revokes the
+        # trainer instead of retrying forever.  Elastic re-enrollment
+        # restarts the pump if the device comes back.
+        self.evict_after = config.run.evict_after
+        self._fail_streak: dict[str, int] = {}
+        self.evicted: list[str] = []
+        self._evicted_pending: list[str] = []
         # Async DP accounting: q = 1 (NO amplification-by-subsampling —
         # buffer membership is availability-ordered, not uniformly
         # sampled); each APPLIED aggregation is charged as one Gaussian
@@ -157,6 +245,10 @@ class AsyncFederatedCoordinator:
 
     def close(self) -> None:
         self._stop.set()
+        with self._version_cv:
+            # Wake pumps parked on the version condition — shutdown must
+            # not depend on their poll timeout.
+            self._version_cv.notify_all()
         for t in self._threads:
             t.join(timeout=2 * self.request_timeout)
         for c in self._clients.values():
@@ -165,6 +257,10 @@ class AsyncFederatedCoordinator:
         if self._ckpt is not None:
             self._ckpt.close()
             self._ckpt = None
+        if self.health is not None:
+            with self._health_lock:
+                self.health.flush()
+                self.health.close()
 
     def __enter__(self):
         return self
@@ -178,6 +274,7 @@ class AsyncFederatedCoordinator:
         dispatchers must never read params mid-server-update.  The frame is
         encoded once per model VERSION and shared read-only by every pump
         (``comm.broadcast_encode_total``), instead of once per dispatch."""
+        from colearn_federated_learning_tpu.comm.downlink import host_params
         from colearn_federated_learning_tpu.utils.serialization import (
             pytree_to_bytes,
         )
@@ -185,8 +282,10 @@ class AsyncFederatedCoordinator:
         with self._state_lock:
             v = self.version
             if self._snap_cache is None or self._snap_cache[0] != v:
-                params_np = jax.tree.map(np.asarray,
-                                         self.server_state.params)
+                # host_params reads sharded leaves PER SHARD (the PR 9
+                # gather-free path) and is a plain asarray when the
+                # server runs replicated.
+                params_np = host_params(self.server_state.params)
                 body = memoryview(pytree_to_bytes(params_np, {"round": v}))
                 telemetry.get_registry().counter(
                     "comm.broadcast_encode_total").inc()
@@ -207,10 +306,23 @@ class AsyncFederatedCoordinator:
         while not self._stop.is_set():
             with self._version_cv:
                 while self.version == last_v and not self._stop.is_set():
-                    self._version_cv.wait(0.1)
+                    # The timeout is a belt-and-braces poll, NOT the wake
+                    # mechanism: the aggregator notifies under the cv it
+                    # holds across the version increment, and close()
+                    # notifies after setting the stop event — tests pin
+                    # liveness with this poll inflated to minutes.
+                    self._version_cv.wait(self._cv_poll_s)
             if self._stop.is_set():
                 return
+            if dev.device_id in self._pruned:
+                # Paused pump (straggler pruning): a pruned device is a
+                # predicted dropout — dispatching would burn its compute
+                # on an update destined for the staleness discard.  Idle
+                # on the stop event until probation re-admits it.
+                self._stop.wait(0.25)
+                continue
             v, _params_np, body = self._snapshot()
+            t_req = time.perf_counter()
             try:
                 with self.tracer.span("dispatch_train",
                                       device=dev.device_id, version=v):
@@ -232,6 +344,16 @@ class AsyncFederatedCoordinator:
                 )
                 telemetry.get_registry().counter(
                     "async.dispatch_failures").inc()
+                self._record_health(dev.device_id, retry=1)
+                streak = self._fail_streak.get(dev.device_id, 0) + 1
+                self._fail_streak[dev.device_id] = streak
+                if streak >= self.evict_after:
+                    # Dead-pump eviction: retrying a permanently-dead
+                    # peer every backoff forever wastes a thread and
+                    # keeps it counted as an enrolled trainer.  Revoke
+                    # and stop; elastic re-enrollment restarts the pump.
+                    self._evict(dev)
+                    return
                 # Replace the connection (a late reply on the old socket
                 # would desynchronise the request/reply stream), back off,
                 # and RETRY the same version — last_v only advances on
@@ -248,10 +370,116 @@ class AsyncFederatedCoordinator:
                         "comm.reconnect_failures_total").inc()
                 self._stop.wait(0.2)
                 continue
+            self._fail_streak.pop(dev.device_id, None)
+            self._record_health(dev.device_id, round=v,
+                                latency_s=time.perf_counter() - t_req)
             last_v = v
             self._results.put((dev.device_id, header["meta"], delta, v))
 
+    def _record_health(self, device_id: str, **kw) -> None:
+        """Thread-safe ledger append (pumps + aggregator share it)."""
+        if self.health is None:
+            return
+        with self._health_lock:
+            self.health.record(str(device_id), **kw)
+
+    def _evict(self, dev: DeviceInfo) -> None:
+        """Revoke a trainer whose pump hit ``evict_after`` consecutive
+        dispatch failures.  Runs ON the dying pump thread; the thread
+        renames itself so a later elastic re-admission of the same
+        device can start a fresh pump under the canonical name."""
+        with self._state_lock:
+            self.trainers = [t for t in self.trainers
+                             if t.device_id != dev.device_id]
+            self.evicted.append(dev.device_id)
+            self._evicted_pending.append(dev.device_id)
+        cli = self._clients.pop(dev.device_id, None)
+        if cli is not None:
+            cli.close()
+        self._fail_streak.pop(dev.device_id, None)
+        telemetry.get_registry().counter("fed.devices_evicted_total").inc()
+        self._record_health(dev.device_id, eviction=1)
+        threading.current_thread().name = (
+            f"dispatch-{dev.device_id}-evicted")
+
+    def _update_pruning(self, agg_idx: int) -> None:
+        """Once per aggregation: probation re-admission, then pruning.
+
+        Re-admission runs first — a device whose probation window ended
+        gets its pump back (with a clean streak) before this
+        aggregation's candidates are scored.  Candidates come from two
+        triggers: ``prune_after`` consecutive too-stale discards
+        (reason="stale"), and a health-ledger score at or above
+        ``prune_score`` (reason="score"), where the score is the
+        ledger's weighted failure count plus a latency term — how far
+        the device's latency EWMA sits above the fleet median, in
+        multiples (CLIP's predicted-dropout signal without a second
+        threshold).  Pruning never shrinks the active pump set below
+        ``buffer_size``: the buffer must stay fillable."""
+        reg = telemetry.get_registry()
+        for d in [d for d, until in self._pruned.items()
+                  if until <= agg_idx]:
+            del self._pruned[d]
+            self._stale_streak.pop(d, None)
+            reg.counter("async.devices_readmitted_total").inc()
+        candidates: list[tuple[float, str, str]] = []
+        if self.prune_after:
+            for d, streak in self._stale_streak.items():
+                if streak >= self.prune_after and d not in self._pruned:
+                    candidates.append((float(streak), d, "stale"))
+        if self.prune_score:
+            with self._health_lock:
+                fleet = self.health.devices()
+            ewmas = [h.lat_ewma for h in fleet.values()
+                     if h.lat_ewma is not None]
+            median = float(np.median(ewmas)) if ewmas else 0.0
+            flagged = {d for _, d, _ in candidates}
+            for d, h in fleet.items():
+                if d in self._pruned or d in flagged:
+                    continue
+                eff = h.score()
+                if median > 0 and h.lat_ewma is not None:
+                    eff += max(0.0, h.lat_ewma / median - 1.0)
+                if eff >= self.prune_score:
+                    candidates.append((eff, d, "score"))
+        if not candidates:
+            return
+        # Worst offenders first; stop the moment one more pause would
+        # leave fewer active pumps than the buffer needs.
+        candidates.sort(key=lambda c: (-c[0], c[1]))
+        with self._state_lock:
+            enrolled = {t.device_id for t in self.trainers}
+        for _, d, reason in candidates:
+            if d not in enrolled:
+                continue
+            active = len(enrolled) - len(self._pruned)
+            if active - 1 < self.buffer_size:
+                break
+            self._pruned[d] = agg_idx + self.probation
+            reg.counter("async.devices_pruned_total",
+                        labels={"reason": reason}).inc()
+
+    def _health_async_feed(self) -> dict:
+        """Per-aggregation ledger flush + merged fleet view (the sync
+        coordinator's ``_health_round_feed``, async flavor).  The pumps
+        already attributed latency/retry/eviction and the collect loop
+        attributed deadline misses, so this only folds the transport's
+        per-device retry deltas, flushes durably, and reloads the
+        directory (merged across any co-located writers)."""
+        from colearn_federated_learning_tpu.telemetry import health as _hl
+
+        with self._health_lock:
+            _hl.feed_transport_retries(self.health,
+                                       self._health_retry_seen)
+            self.health.flush()
+            fleet = _hl.load_health(os.path.dirname(self.health.path))
+        _hl.export_gauges(fleet)
+        return fleet
+
     def _start_dispatchers(self) -> None:
+        # Dead pumps (evicted devices) drop out of the dedupe set so a
+        # re-enrolled device gets a fresh pump under the same name.
+        self._threads = [t for t in self._threads if t.is_alive()]
         started = {t.name for t in self._threads}
         for d in self.trainers:
             name = f"dispatch-{d.device_id}"
@@ -286,7 +514,7 @@ class AsyncFederatedCoordinator:
         produces nothing for ``2 × request_timeout`` — dispatchers retry
         dead peers forever, so the aggregator owns the escalation."""
         from colearn_federated_learning_tpu.comm.aggregation import (
-            UpdateFolder,
+            StreamingFolder,
         )
 
         if self.buffer_size > len(self.trainers):
@@ -297,11 +525,18 @@ class AsyncFederatedCoordinator:
                 "buffer could never fill"
             )
         self._start_dispatchers()
+        reg = telemetry.get_registry()
         t0 = time.perf_counter()
-        # Only the aggregator mutates server state, so one shape snapshot
-        # serves the whole collection loop.
-        folder = UpdateFolder(jax.tree.map(np.asarray,
-                                           self.server_state.params))
+        # StreamingFolder (the uplink fast path + sharded server): topk
+        # replies stage their wire (indices, values) sparse — O(k) per
+        # update — and under a tp placement every contribution folds
+        # shard-wise.  Staging keys are ARRIVAL-ORDERED (a device can
+        # land updates for two versions in one buffer, so the bare
+        # client_id would collide), and the zero-padded arrival index
+        # makes the folder's sorted finalize reproduce the arrival-order
+        # sum the dense UpdateFolder used to compute — bitwise.
+        folder = StreamingFolder(self._shapes_np,
+                                 placement=self._placement)
         staleness: list[int] = []
         contributors: list[str] = []
         weights: list[float] = []
@@ -325,11 +560,23 @@ class AsyncFederatedCoordinator:
                                   + 2.0 * self.request_timeout)
                 tau = self.version - v
                 if tau > self.max_staleness:
+                    # Per-device attribution: the labeled child rolls up
+                    # into the unlabeled family, so aggregate readers
+                    # (soak deltas) keep working.
                     discarded += 1
+                    reg.counter("async.updates_discarded_stale",
+                                labels={"device": str(dev_id)}).inc()
+                    self._stale_streak[dev_id] = (
+                        self._stale_streak.get(dev_id, 0) + 1)
+                    self._record_health(dev_id, round=self.version,
+                                        deadline_miss=1)
                     continue
+                self._stale_streak.pop(dev_id, None)
                 w = (float(meta.get("weight", 1.0))
                      * (1.0 + tau) ** (-self.staleness_exponent))
-                folder.add(meta, delta, weight=w)
+                fmeta = dict(meta)
+                fmeta["client_id"] = f"{len(staleness):08d}@{dev_id}"
+                folder.add(fmeta, delta, weight=w)
                 staleness.append(tau)
                 contributors.append(dev_id)
                 weights.append(w)
@@ -365,11 +612,15 @@ class AsyncFederatedCoordinator:
                 with self._version_cv:
                     self.version += 1
                     self._version_cv.notify_all()
-        reg = telemetry.get_registry()
+        agg_idx = len(self.history)
         reg.counter("async.aggregations_total").inc()
-        reg.counter("async.updates_discarded_stale").inc(discarded)
+        # (Too-stale discards were already counted at the discard site —
+        # the labeled per-device children roll up into the unlabeled
+        # async.updates_discarded_stale family.)
+        if self.prune_enabled:
+            self._update_pruning(agg_idx)
         rec = {
-            "aggregation": len(self.history),
+            "aggregation": agg_idx,
             "model_version": self.version,
             "buffer_size": self.buffer_size,
             "staleness_mean": float(np.mean(staleness)),
@@ -386,10 +637,21 @@ class AsyncFederatedCoordinator:
             # Key only present when the quorum feature is on, so default
             # aggregation records stay byte-identical.
             rec["skipped_quorum"] = skipped_quorum
+        if self.prune_enabled:
+            # Same convention: the pruning keys exist only when the
+            # feature is on.
+            rec["pruned"] = sorted(self._pruned)
+        with self._state_lock:
+            if self._evicted_pending:
+                rec["evicted"] = self._evicted_pending
+                self._evicted_pending = []
         reg.histogram("async.agg_time_s").observe(rec["agg_time_s"])
         if self.accountant is not None and mean_delta is not None:
             rec["dp_z_eff"] = self._charge_privacy(weights, contributors)
             rec["dp_epsilon"] = self.accountant.epsilon()
+        if self.health is not None:
+            fleet = self._health_async_feed()
+            rec.update(telemetry.health_record_keys(fleet))
         self.history.append(rec)
         return rec
 
@@ -434,9 +696,13 @@ class AsyncFederatedCoordinator:
         return float(z_eff)
 
     def evaluate(self) -> dict:
+        from colearn_federated_learning_tpu.comm.downlink import host_params
+
         if self.evaluator is None:
             raise RuntimeError("no evaluator was assigned")
-        params_np = jax.tree.map(np.asarray, self.server_state.params)
+        # Gather-free under a tp placement (per-shard host reads), a
+        # plain asarray when the server runs replicated.
+        params_np = host_params(self.server_state.params)
         with self.tracer.span("evaluate"):
             header, _ = self._clients[self.evaluator.device_id].request(
                 protocol.attach_trace({"op": "eval"},
@@ -470,8 +736,24 @@ class AsyncFederatedCoordinator:
             (self.server_state,)
         )
         (self.server_state,) = state
+        if self._placement is not None:
+            # Restored leaves may come back as host arrays; re-place
+            # them on the server mesh so the resumed run keeps the
+            # sharded fold/update/snapshot plane.
+            s = self.server_state
+            put = self._placement.shard
+            self.server_state = type(s)(
+                params=put(s.params),
+                opt_m=put(s.opt_m) if s.opt_m is not None else None,
+                opt_v=put(s.opt_v) if s.opt_v is not None else None,
+                control=(put(s.control) if s.control is not None
+                         else None),
+                round_idx=s.round_idx,
+            )
         self.history = history
-        self.version = step
+        with self._state_lock:
+            self.version = step
+            self._snap_cache = None
         if self.accountant is not None:
             # The async mechanism varies per aggregation (realized z_eff
             # depends on the buffer's staleness weights), so the budget is

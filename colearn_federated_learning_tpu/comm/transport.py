@@ -222,6 +222,9 @@ class TensorClient:
         self.ident = ident or f"{host}:{port}"
         self._rng = random.Random(zlib.crc32(self.ident.encode()))
         self.closed = False
+        # Backoff sleeps wait on this instead of time.sleep so close()
+        # wakes a mid-backoff retrier immediately (CL015).
+        self._closing = threading.Event()
         self._sock = protocol.connect(host, port, timeout=timeout)
 
     def _reconnect(self, timeout: Optional[float]) -> None:
@@ -294,8 +297,11 @@ class TensorClient:
                 delay = retry.delay(attempt, self._rng)
                 if deadline is not None:
                     delay = min(delay, max(0.0, deadline - time.monotonic()))
-                if delay > 0:
-                    time.sleep(delay)
+                if delay > 0 and self._closing.wait(delay):
+                    # close() fired mid-backoff: abort instead of
+                    # reconnecting onto a socket the owner gave up on.
+                    raise protocol.ConnectionClosed(
+                        f"{self.ident}: client closed during retry backoff")
                 # Reconnect may itself fail (peer rebooting): that is the
                 # next attempt's failure, charged against the same budget.
                 try:
@@ -314,4 +320,5 @@ class TensorClient:
         # the dying socket sees the flag and aborts instead of retrying
         # onto a fresh connection.
         self.closed = True
+        self._closing.set()
         protocol.close_quietly(self._sock)

@@ -43,36 +43,40 @@ _CLI = "colearn_federated_learning_tpu.cli"
 class KillSpec:
     """One scheduled SIGKILL.
 
-    ``target`` is ``"coordinator"``, ``"broker"``,
-    ``"worker:<client_id>"`` or ``"aggregator:<n>"``.  The signal is
-    sent as soon as the round record for ``after_round`` appears, i.e.
-    it lands mid-round ``after_round + 1``.  ``restart`` respawns the
-    victim: a worker re-announces on a fresh port (and is re-admitted
-    by the elastic coordinator after eviction), the coordinator comes
-    back with ``--resume``, and the broker rebinds its ORIGINAL port —
-    the control-plane SPOF heals through the worker re-enrollment
-    watchdog and the coordinator's ``_rebuild_broker`` without any
-    address change.  An aggregator is the one role that may STAY dead
-    (``restart=False``): the root must re-home its slice onto a
-    sibling or quorum-drop it — that failover IS the thing the agg
-    soak gates on."""
+    ``target`` is ``"coordinator"``, ``"async-coordinator"``,
+    ``"broker"``, ``"worker:<client_id>"`` or ``"aggregator:<n>"``.
+    The signal is sent as soon as the round record for ``after_round``
+    appears, i.e. it lands mid-round ``after_round + 1`` (for the
+    buffered-async plane ``after_round`` counts AGGREGATIONS — the kill
+    lands mid-aggregation, while dispatcher pumps are in flight).
+    ``restart`` respawns the victim: a worker re-announces on a fresh
+    port (and is re-admitted by the elastic coordinator after
+    eviction), the coordinator comes back with ``--resume``, and the
+    broker rebinds its ORIGINAL port — the control-plane SPOF heals
+    through the worker re-enrollment watchdog and the coordinator's
+    ``_rebuild_broker`` without any address change.  An aggregator is
+    the one role that may STAY dead (``restart=False``): the root must
+    re-home its slice onto a sibling or quorum-drop it — that failover
+    IS the thing the agg soak gates on."""
 
     target: str
     after_round: int
     restart: bool = True
 
     def __post_init__(self):
-        if self.target not in ("coordinator", "broker") and not (
+        singletons = ("coordinator", "async-coordinator", "broker")
+        if self.target not in singletons and not (
                 self.target.split(":", 1)[0] in ("worker", "aggregator")
                 and ":" in self.target
                 and self.target.split(":", 1)[1].isdigit()):
             raise ValueError(
-                f"target must be 'coordinator', 'broker', "
-                f"'worker:<id>' or 'aggregator:<n>', got {self.target!r}")
+                f"target must be 'coordinator', 'async-coordinator', "
+                f"'broker', 'worker:<id>' or 'aggregator:<n>', "
+                f"got {self.target!r}")
         if self.after_round < 0:
             raise ValueError(
                 f"after_round must be >= 0, got {self.after_round}")
-        if self.target in ("coordinator", "broker") and not self.restart:
+        if self.target in singletons and not self.restart:
             raise ValueError(
                 f"killing the {self.target} without restart ends the "
                 "federation; use restart=True")
@@ -222,6 +226,28 @@ class _Fleet:
                 "--round-timeout", str(round_timeout),
                 "--enroll-timeout", str(enroll_timeout),
                 "--no-evaluator", "--per-client-eval", "--elastic"]
+        if resume:
+            args.append("--resume")
+        self.coord = self.spawn(
+            args, stdout=self._log_file("coordinator.out"),
+            stderr=subprocess.PIPE, text=True)
+        return self.coord
+
+    def start_async_coordinator(self, cfg: list[str], host: str, port: int,
+                                n_workers: int, round_timeout: float,
+                                enroll_timeout: float, buffer_size: int,
+                                resume: bool) -> subprocess.Popen:
+        """Buffered-async flavor of :meth:`start_coordinator`:
+        ``--async-buffer`` switches the CLI onto
+        comm/async_coordinator.py, which has no per-client eval plane —
+        the gate compares train-loss trajectories instead."""
+        args = ["coordinate", *cfg, "--broker-host", host,
+                "--broker-port", str(port),
+                "--min-devices", str(n_workers),
+                "--round-timeout", str(round_timeout),
+                "--enroll-timeout", str(enroll_timeout),
+                "--async-buffer", str(buffer_size),
+                "--no-evaluator", "--elastic"]
         if resume:
             args.append("--resume")
         self.coord = self.spawn(
@@ -618,5 +644,390 @@ def run_agg_soak(
         "flight_missing": tree["flight_missing"],
         "kills": tree["kills"],
         "records": tree["records"],
+        "workdir": workdir,
+    }
+
+
+def _async_config_flags(aggregations: int, n_workers: int, seed: int,
+                        checkpoint_dir: Optional[str] = None) -> list[str]:
+    """The async-soak federation: the sync soak's tiny CPU config plus a
+    fixed-clip DP mechanism, so every aggregation record carries the
+    realized ``dp_z_eff``/``dp_epsilon`` the replay gate re-derives.
+    ``--evict-after`` is loosened vs the sync soak's 2: injected
+    client-side flaps land as consecutive pump failures, and the gate
+    wants them ATTRIBUTED (health ledger retries), not escalated into
+    evictions of perfectly healthy workers.  The noise multiplier is
+    deliberately tiny: the replay gate needs every aggregation CHARGED
+    (any mechanism will do), while the loss-parity gate needs both runs
+    to actually converge — production-grade noise on a 3-client toy
+    federation swamps the clipped deltas and both trajectories
+    diverge."""
+    flags = _config_flags(aggregations, n_workers, seed,
+                          checkpoint_dir=checkpoint_dir)
+    flags += ["--evict-after", "4",
+              "--dp-clip", "1.0",
+              "--dp-noise-multiplier", str(_ASYNC_DP_NOISE),
+              "--dp-delta", str(_ASYNC_DP_DELTA)]
+    return flags
+
+
+_ASYNC_DP_DELTA = 1e-5
+_ASYNC_DP_NOISE = 0.02
+
+
+def _async_fault_plan() -> dict:
+    """Client-site transport faults for the FAULTED async run: the plan
+    is installed in the coordinator process (``--fault-plan``), so these
+    fire inside the dispatcher pumps' ``TensorClient.request`` calls —
+    flaps surface as pump failures the health ledger must attribute as
+    retries, delays stretch the per-device latency EWMA.  Count-bounded
+    so the run still converges."""
+    return {"seed": 0, "faults": [
+        {"kind": "flap_reconnect", "device_id": "*", "op": "train",
+         "count": 2, "site": "client"},
+        {"kind": "delay", "device_id": "*", "op": "train",
+         "ms": 150, "count": 3, "site": "client"},
+    ]}
+
+
+def _run_async_fleet(
+    aggregations: int,
+    n_workers: int,
+    buffer_size: int,
+    kills: list[KillSpec],
+    workdir: str,
+    round_timeout: float,
+    enroll_timeout: float,
+    timeout_s: float,
+    seed: int,
+    fault_plan: Optional[dict] = None,
+    log_fn: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """One buffered-async subprocess federation (broker + N workers +
+    async coordinator), with the proc-soak kill loop re-keyed on
+    AGGREGATION records: the async plane logs ``{"aggregation": i,
+    "model_version": v, ...}`` lines instead of round records, and a
+    ``KillSpec("async-coordinator", after_round=k)`` fires the moment
+    aggregation ``k``'s record appears — mid-aggregation ``k + 1``,
+    while dispatcher pumps are in flight.  Records are deduplicated by
+    aggregation index (LAST wins: a resumed incarnation's re-run of an
+    uncommitted aggregation replaces the lost one), and model-version
+    monotonicity is checked per incarnation as the stream arrives."""
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    flight_dir = os.path.join(workdir, "flight")
+
+    env = dict(os.environ)
+    env["PYTHONUNBUFFERED"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+
+    fleet = _Fleet(workdir, env)
+    watchdog = threading.Timer(timeout_s, fleet.kill_all)
+    watchdog.daemon = True
+
+    records: dict[int, dict] = {}
+    events: list[dict] = []
+    resumed = 0
+    incarnations = 1
+    delivered: list[dict] = []
+    pending = sorted(kills, key=lambda k: (k.after_round, k.target))
+    version_monotonic = True
+    last_version = -1
+    rc: Optional[int] = None
+
+    try:
+        watchdog.start()
+        flight_flags = ["--flight-dir", flight_dir,
+                        "--flight-heartbeat", "0.5"]
+        health_flags = ["--health-dir", os.path.join(workdir, "health")]
+        host, port = fleet.start_broker(timeout=30.0, extra=flight_flags)
+        worker_cfg = (_async_config_flags(aggregations, n_workers, seed)
+                      + flight_flags + health_flags)
+        for i in range(n_workers):
+            fleet.start_worker(i, worker_cfg, host, port)
+        coord_cfg = (_async_config_flags(aggregations, n_workers, seed,
+                                         checkpoint_dir=ckpt_dir)
+                     + flight_flags + health_flags)
+        if fault_plan is not None:
+            plan_path = os.path.join(workdir, "fault_plan.json")
+            with open(plan_path, "w") as f:
+                json.dump(fault_plan, f)
+            coord_cfg += ["--fault-plan", plan_path]
+
+        def launch(resume: bool) -> subprocess.Popen:
+            return fleet.start_async_coordinator(
+                coord_cfg, host, port, n_workers, round_timeout,
+                enroll_timeout, buffer_size, resume=resume)
+
+        coord = launch(resume=False)
+        restart_pending = False
+        err_log = fleet._log_file("coordinator.err")
+        while True:
+            line = coord.stderr.readline()
+            if line:
+                err_log.write(line.encode())
+                err_log.flush()
+            if not line:
+                coord.wait()
+                if restart_pending:
+                    restart_pending = False
+                    incarnations += 1
+                    # A fresh incarnation resumes from its checkpointed
+                    # version — which may sit BELOW the dead process's
+                    # last streamed record (uncommitted aggregations are
+                    # lost by design).  Monotonicity restarts with it.
+                    last_version = -1
+                    coord = launch(resume=True)
+                    continue
+                rc = coord.returncode
+                break
+            doc = _parse_json(line.strip())
+            if doc is None:
+                continue
+            if "event" in doc:
+                events.append(doc)
+                if doc["event"] == "resumed":
+                    resumed += 1
+                continue
+            if "aggregation" not in doc:
+                continue
+            agg = int(doc["aggregation"])
+            v = doc.get("model_version")
+            if v is not None:
+                if int(v) <= last_version:
+                    version_monotonic = False
+                last_version = int(v)
+            records[agg] = doc         # last record per aggregation wins
+            if log_fn is not None:
+                log_fn(doc)
+            while pending and pending[0].after_round <= agg:
+                spec = pending.pop(0)
+                kill_rec = {**dataclasses.asdict(spec),
+                            "fired_after_round": agg}
+                if spec.target in ("coordinator", "async-coordinator"):
+                    kill_rec["pid"] = coord.pid
+                    coord.send_signal(signal.SIGKILL)
+                    restart_pending = True
+                elif spec.target == "broker":
+                    victim = fleet.broker
+                    if victim is not None and victim.poll() is None:
+                        kill_rec["pid"] = victim.pid
+                        victim.send_signal(signal.SIGKILL)
+                        victim.wait()
+                    fleet.restart_broker()
+                else:
+                    wid = int(spec.target.split(":", 1)[1])
+                    victim = fleet.workers.get(wid)
+                    if victim is not None and victim.poll() is None:
+                        kill_rec["pid"] = victim.pid
+                        victim.send_signal(signal.SIGKILL)
+                        victim.wait()
+                    if spec.restart:
+                        fleet.start_worker(wid, worker_cfg, host, port)
+                delivered.append(kill_rec)
+    finally:
+        watchdog.cancel()
+        fleet.close()
+
+    if rc is None:
+        raise RuntimeError(
+            f"async coordinator never exited cleanly within {timeout_s}s "
+            f"(records for aggregations {sorted(records)})")
+
+    from colearn_federated_learning_tpu.telemetry import flight as _flight
+
+    dumps = _flight.load_flight_dumps(flight_dir)
+    dumped_pids = {d.get("pid") for d in dumps if "error" not in d}
+    flight_missing = sorted({k["pid"] for k in delivered if "pid" in k}
+                            - dumped_pids)
+
+    recs = [records[a] for a in sorted(records)]
+    return {
+        "aggregations_run": len(recs),
+        "records": recs,
+        "version_monotonic": version_monotonic,
+        "resumed": resumed,
+        "coordinator_incarnations": incarnations,
+        "kills": delivered,
+        "flight_dumps": len(dumped_pids),
+        "flight_missing": flight_missing,
+        "events": events,
+        "exit_code": rc,
+        "workdir": workdir,
+    }
+
+
+def _tail_loss(records: list[dict], n: int = 3) -> float:
+    """Mean train loss over the last ``n`` aggregations — buffered-async
+    losses are thread-timing noisy aggregation to aggregation, so the
+    gate compares smoothed tails, not single records."""
+    import math as _math
+
+    tail = [float(r["train_loss"]) for r in records
+            if "train_loss" in r
+            and _math.isfinite(float(r["train_loss"]))][-n:]
+    return sum(tail) / len(tail) if tail else float("inf")
+
+
+def run_async_soak(
+    aggregations: int = 6,
+    n_workers: int = 3,
+    buffer_size: int = 2,
+    workdir: Optional[str] = None,
+    round_timeout: float = 120.0,
+    enroll_timeout: float = 90.0,
+    timeout_s: float = 600.0,
+    kill: bool = True,
+    seed: int = 0,
+    loss_tol: float = 0.75,
+    log_fn: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """Buffered-async chaos gate: SIGKILL the async coordinator
+    mid-aggregation, relaunch with ``--resume``, and hold the recovered
+    run to the invariants a lost buffer must not break.
+
+    Two full subprocess federations, identical config and seed:
+
+    - **faulted** — the async coordinator is SIGKILLed the moment
+      aggregation ``aggregations // 2 - 1``'s record streams (so the
+      signal lands mid-aggregation with dispatcher pumps in flight,
+      buffered updates unfolded, the version condition mid-notify), then
+      relaunched with ``--resume``; a count-bounded client-site
+      :class:`~.plan.FaultPlan` also rides on its dispatcher pumps;
+    - **baseline** — the same federation, kill-free and fault-free.
+
+    Gates (``colearn chaos --async``):
+
+    - *version monotonicity* — within each coordinator incarnation the
+      streamed ``model_version`` strictly increases; resume restarts
+      from the checkpointed version and uncommitted aggregations are
+      re-run, never replayed out of order;
+    - *no RDP double-charge* — replaying each final aggregation record's
+      ``dp_z_eff`` into a fresh accountant must land on the final
+      record's ``dp_epsilon``: the resumed coordinator rebuilt its
+      budget from the checkpointed history exactly once;
+    - *loss parity* — the faulted run's tail train loss stays within
+      ``loss_tol`` of the kill-free baseline's (async losses are
+      thread-timing noisy; the tolerance covers scheduling, not
+      divergence);
+    - *attribution* — the SIGKILLed pid left a parseable flight dump
+      whose postmortem names the coordinator role, the health ledgers
+      survive the kill, and the injected pump faults show up as
+      per-device retry counts in the ledger."""
+    if aggregations < 4:
+        raise ValueError(
+            f"async soak needs >= 4 aggregations so the kill lands after "
+            f"a committed checkpoint, got {aggregations}")
+    workdir = workdir or tempfile.mkdtemp(prefix="colearn_asyncsoak_")
+    os.makedirs(workdir, exist_ok=True)
+    kills = ([KillSpec("async-coordinator",
+                       after_round=max(1, aggregations // 2 - 1))]
+             if kill else [])
+
+    faulted = _run_async_fleet(
+        aggregations=aggregations, n_workers=n_workers,
+        buffer_size=buffer_size, kills=kills,
+        workdir=os.path.join(workdir, "faulted"),
+        round_timeout=round_timeout, enroll_timeout=enroll_timeout,
+        timeout_s=timeout_s, seed=seed,
+        fault_plan=_async_fault_plan() if kill else None, log_fn=log_fn)
+    baseline = _run_async_fleet(
+        aggregations=aggregations, n_workers=n_workers,
+        buffer_size=buffer_size, kills=[],
+        workdir=os.path.join(workdir, "baseline"),
+        round_timeout=round_timeout, enroll_timeout=enroll_timeout,
+        timeout_s=timeout_s, seed=seed, fault_plan=None, log_fn=log_fn)
+
+    # RDP replay: the deduplicated record stream IS the final
+    # coordinator's history (LAST record per aggregation wins, exactly
+    # like the checkpointed history the resumed incarnation extended).
+    # Re-deriving epsilon from the per-record realized multipliers must
+    # land on the final record's figure — a double-charged resume (or a
+    # restore that failed to reset) diverges here.
+    from colearn_federated_learning_tpu.privacy.accountant import (
+        RdpAccountant,
+    )
+
+    acct = RdpAccountant(noise_multiplier=_ASYNC_DP_NOISE,
+                         sampling_rate=1.0, delta=_ASYNC_DP_DELTA)
+    final_eps = None
+    for rec in faulted["records"]:
+        if "dp_z_eff" in rec:
+            acct.step(1, sampling_rate=1.0,
+                      noise_multiplier=float(rec["dp_z_eff"]))
+        if "dp_epsilon" in rec:
+            final_eps = float(rec["dp_epsilon"])
+    replayed_eps = acct.epsilon()
+    import math as _math
+
+    dp_replay_ok = (final_eps is not None
+                    and _math.isfinite(final_eps)
+                    and _math.isfinite(replayed_eps)
+                    and abs(replayed_eps - final_eps)
+                    <= 1e-6 * max(1.0, abs(final_eps)))
+
+    final_loss = _tail_loss(faulted["records"])
+    baseline_loss = _tail_loss(baseline["records"])
+    loss_gap = abs(final_loss - baseline_loss)
+    loss_gap_ok = _math.isfinite(loss_gap) and loss_gap <= loss_tol
+
+    # Postmortem attribution: the SIGKILLed async coordinator's black
+    # box must parse and the merged report must name the coordinator
+    # role for its pid.
+    from colearn_federated_learning_tpu.telemetry import flight as _flight
+
+    killed_pids = {k["pid"] for k in faulted["kills"] if "pid" in k}
+    if killed_pids:
+        dumps = _flight.load_flight_dumps(
+            os.path.join(workdir, "faulted", "flight"))
+        report = _flight.postmortem_report(dumps)
+        attributed = any(
+            p.get("pid") in killed_pids
+            and str(p.get("role", "")) == "coordinator"
+            for p in report.get("processes", []))
+    else:
+        attributed = not kill
+
+    # Health-ledger durability + fault attribution: the ledgers must
+    # survive the SIGKILL (parse, non-empty), and with the fault plan
+    # armed at least one device must carry attributed retries — the
+    # injected pump flaps landed in the per-device ledger, not just a
+    # process-local counter that died with its incarnation.
+    from colearn_federated_learning_tpu.telemetry import health as _health
+
+    try:
+        devices = _health.load_health(
+            os.path.join(workdir, "faulted", "health"))
+    except ValueError:
+        devices = {}
+    health_ok = bool(devices)
+    fault_retries = sum(int(h.counts.get("retry", 0))
+                        for h in devices.values())
+    faults_attributed = (not kill) or fault_retries >= 1
+
+    return {
+        "exit_code": faulted["exit_code"],
+        "baseline_exit_code": baseline["exit_code"],
+        "aggregations_run": faulted["aggregations_run"],
+        "baseline_aggregations_run": baseline["aggregations_run"],
+        "version_monotonic": (faulted["version_monotonic"]
+                              and baseline["version_monotonic"]),
+        "resumed": faulted["resumed"],
+        "coordinator_incarnations": faulted["coordinator_incarnations"],
+        "dp_replay_ok": dp_replay_ok,
+        "dp_epsilon": final_eps,
+        "dp_epsilon_replayed": replayed_eps,
+        "final_loss": final_loss,
+        "baseline_final_loss": baseline_loss,
+        "loss_gap": loss_gap,
+        "loss_gap_ok": loss_gap_ok,
+        "postmortem_attributed": attributed,
+        "health_ledger_ok": health_ok,
+        "health_devices": len(devices),
+        "fault_retries": fault_retries,
+        "faults_attributed": faults_attributed,
+        "flight_missing": faulted["flight_missing"],
+        "kills": faulted["kills"],
+        "records": faulted["records"],
         "workdir": workdir,
     }

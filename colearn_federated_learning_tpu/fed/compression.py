@@ -26,6 +26,12 @@ rounds via :func:`feedback_compress` (``FedConfig.compress_feedback``).
   :func:`topk_leaf_arrays` and scatter-adds at finalize — O(k) host work
   per contribution, never densifying on the hot path
   (comm/aggregation.py).
+- ``topk8``: the quantized-sparse hybrid — a topk frame whose values are
+  int8 with a per-leaf dequant scale (``{"i", "v": int8[k], "n", "s"}``,
+  5 bytes/kept entry vs topk's 8).  Decoded inside
+  :func:`topk_leaf_arrays`, so it rides the same sparse-native O(k)
+  StreamingFolder fold; error feedback composes and re-injects the
+  quantization error along with the sparsification drop.
 - ``none``: passthrough.
 
 The on-device engine never compresses — its aggregation is a psum, no
@@ -38,10 +44,11 @@ from typing import Any
 
 import numpy as np
 
-SCHEMES = ("none", "int8", "topk")
+SCHEMES = ("none", "int8", "topk", "topk8")
 _Q, _S = "q", "s"
 _I, _V, _N = "i", "v", "n"
 TOPK_FRACTION = 0.05
+TOPK_SCHEMES = ("topk", "topk8")
 
 
 def _is_qleaf(node: Any) -> bool:
@@ -52,12 +59,26 @@ def _is_kleaf(node: Any) -> bool:
     return isinstance(node, dict) and set(node) == {_I, _V, _N}
 
 
+def _is_k8leaf(node: Any) -> bool:
+    # topk8 hybrid frame: topk indices with int8-quantized values and
+    # the per-leaf dequant scale riding along.
+    return isinstance(node, dict) and set(node) == {_I, _V, _N, _S}
+
+
 def topk_leaf_arrays(node: Any) -> tuple[np.ndarray, np.ndarray, int]:
-    """Split one topk wire leaf into ``(indices, float32 values, size)``.
+    """Split one topk/topk8 wire leaf into ``(indices, float32 values,
+    size)``.
 
     The sparse-native consumers' accessor: comm/aggregation.py stages
     these without ever materializing the dense leaf.  ``size`` is the
-    flat element count of the original leaf."""
+    flat element count of the original leaf.  A topk8 leaf is DECODED
+    here — int8 values times the per-leaf scale — so the sparse fold
+    stage is the one decode site for both frame flavors."""
+    if _is_k8leaf(node):
+        n = int(np.asarray(node[_N]).ravel()[0])
+        vals = (np.asarray(node[_V], np.float32)
+                * np.float32(np.asarray(node[_S]).ravel()[0]))
+        return np.asarray(node[_I]), vals, n
     if not _is_kleaf(node):
         raise TypeError(f"unexpected node {type(node).__name__} in topk tree")
     # _N may arrive off the wire as a 1-element array (see decompress).
@@ -89,10 +110,11 @@ def compress_delta(
             return {_Q: qa, _S: np.float32(scale)}
 
         return jax.tree.map(q, delta), {"compress": "int8"}
-    if scheme == "topk":
+    if scheme in TOPK_SCHEMES:
         from colearn_federated_learning_tpu import native
 
         frac = TOPK_FRACTION if topk_fraction is None else float(topk_fraction)
+        quantize = scheme == "topk8"
 
         def k_of(leaf):
             flat = np.asarray(leaf, np.float32).ravel()
@@ -101,9 +123,21 @@ def compress_delta(
             # Thread-parallel selection when the C++ library is present
             # (native/src/topk.cpp); numpy argpartition otherwise.
             idx, val = native.topk_abs(flat, k)
-            return {_I: idx, _V: val, _N: np.int64(flat.size)}
+            if not quantize:
+                return {_I: idx, _V: val, _N: np.int64(flat.size)}
+            # Hybrid frame: int8 values inside the topk frame — 5
+            # bytes/kept entry instead of 8.  Survivors are the
+            # LARGEST-magnitude entries, so the symmetric scale wastes
+            # no range on near-zeros the selector already dropped.
+            scale = float(np.max(np.abs(val))) / 127.0 if val.size else 0.0
+            if scale == 0.0:
+                q = np.zeros(val.shape, np.int8)
+            else:
+                q = np.clip(np.rint(val / scale), -127, 127).astype(np.int8)
+            return {_I: idx, _V: q, _N: np.int64(flat.size),
+                    _S: np.float32(scale)}
 
-        return jax.tree.map(k_of, delta), {"compress": "topk"}
+        return jax.tree.map(k_of, delta), {"compress": scheme}
     raise ValueError(f"unknown compression {scheme!r} (use {SCHEMES})")
 
 
@@ -128,22 +162,18 @@ def decompress_delta(wire_tree: Any, meta: dict, shapes: Any = None) -> Any:
             )
 
         return walk(wire_tree)
-    if scheme == "topk":
+    if scheme in TOPK_SCHEMES:
         import jax
 
         if shapes is None:
             raise ValueError("topk decompression needs the `shapes` pytree")
 
         def unk(node, ref):
-            if not _is_kleaf(node):
-                raise TypeError(
-                    f"unexpected node {type(node).__name__} in topk tree"
-                )
-            # _N may arrive off the wire as a 1-element array; plain int()
-            # on an ndim>0 array is deprecated (NumPy 2) and will raise.
-            n = int(np.asarray(node[_N]).ravel()[0])
+            # topk_leaf_arrays decodes both frame flavors (topk8 values
+            # dequantize through the per-leaf scale).
+            idx, vals, n = topk_leaf_arrays(node)
             flat = np.zeros(n, np.float32)
-            flat[np.asarray(node[_I])] = np.asarray(node[_V], np.float32)
+            flat[idx] = vals
             return flat.reshape(np.asarray(ref).shape)
 
         # Walk the REFERENCE tree's structure and stop at ITS leaf

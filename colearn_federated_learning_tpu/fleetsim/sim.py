@@ -118,6 +118,9 @@ class FleetSim:
         self.fault_plan = fault_plan
         self.round_deadline_ms = float(round_deadline_ms)
         self._available_fraction_fn = available_fraction_fn
+        # Set by from_population; fit_async needs per-device arrival
+        # rates, not just the fleet-mean fraction.
+        self._traffic = None
         self.history: list[dict] = []
         self.tracer = telemetry.Tracer(process="fleetsim", enabled=False)
         # Per-device health feed (telemetry/health.py): the simulated
@@ -216,7 +219,7 @@ class FleetSim:
             model, example_x, prng.init_key(base_key))
         local_update, num_steps = setup_lib.local_trainer_for_config(
             config, model.apply, spec.shard_capacity)
-        return cls(
+        sim = cls(
             config=config,
             local_update=local_update,
             num_steps=num_steps,
@@ -233,6 +236,8 @@ class FleetSim:
             available_fraction_fn=lambda r: float(
                 traffic.available_mask(r).mean()),
         )
+        sim._traffic = traffic
+        return sim
 
     @classmethod
     def from_learner(cls, learner, chunk_size: int = 1024,
@@ -523,4 +528,212 @@ class FleetSim:
             rec = self.run_round()
             if log_fn is not None:
                 log_fn(rec)
+        return self.history
+
+    # ------------------------------------------------------------- async --
+    def _async_arrival_wait(self, rng, ids: np.ndarray,
+                            now_min: float) -> np.ndarray:
+        """Minutes until each device's NEXT check-in, drawn from the
+        diurnal-Poisson traffic model at sim time ``now_min``: the
+        per-device arrival rate is recovered from the model's window
+        probability (p = 1 - exp(-rate * window)), so the async plane
+        consumes the exact rates the sync cohort sampler does."""
+        spec = self._traffic.spec
+        rnd = int(now_min / spec.round_minutes)
+        p = np.clip(self._traffic.availability_probability(rnd, ids),
+                    1e-6, 1.0 - 1e-9)
+        rate_per_min = -np.log1p(-p) / spec.round_minutes
+        return rng.exponential(1.0, size=ids.shape[0]) / rate_per_min
+
+    def fit_async(
+        self,
+        aggregations: int,
+        buffer_size: int = 32,
+        *,
+        staleness_exponent: float = 0.5,
+        max_staleness: int = 10,
+        prune_after: int = 0,
+        probation: int = 8,
+        straggler_fraction: float = 0.05,
+        straggler_multiplier: float = 20.0,
+        log_fn=None,
+    ) -> list[dict]:
+        """Buffered-asynchronous simulation (FedBuff semantics over the
+        chunked-vmap hot path): devices check in on the diurnal-Poisson
+        traffic model, train against the model version current at
+        dispatch, and the server folds every ``buffer_size`` completions
+        with staleness weights ``(1 + tau)^(-staleness_exponent)``,
+        discarding updates staler than ``max_staleness``.
+
+        The event clock is virtual (sim minutes): per-device service
+        time is lognormal around the traffic model's round window, with
+        a seeded ``straggler_fraction`` of chronic stragglers at
+        ``straggler_multiplier`` x — the tail that bounds a SYNC round
+        but not async throughput, which tracks the arrival rate
+        (``arrival_rate_per_min`` vs ``agg_rate_per_min`` in the
+        records; scripts/bench_fleet.py --async-sweep scales the same
+        model analytically to 1M devices).
+
+        ``prune_after`` > 0 arms the coordinator's straggler-pruning
+        policy in the sim: a device whose updates are discarded
+        too-stale ``prune_after`` times consecutively stops being
+        re-dispatched for ``probation`` aggregations — pruned runs must
+        waste measurably fewer updates at equal final loss (the
+        ``fleet_async_prune`` bench gate).  Groups the buffer by
+        dispatch version and reuses the round-path chunk/fold/finish
+        programs, so the compile-once invariant holds (chunk shapes stay
+        ``chunk_size``-padded)."""
+        import heapq
+
+        if self._traffic is None:
+            raise NotImplementedError(
+                "fit_async needs the traffic model: build the sim with "
+                "FleetSim.from_population")
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        n_dev = self.num_devices
+        if buffer_size > n_dev:
+            raise ValueError(
+                f"buffer_size {buffer_size} exceeds the {n_dev}-device "
+                "fleet — the buffer could never fill")
+        spec = self._traffic.spec
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.config.run.seed, 0xA51C]))
+        # Per-device service time (sim minutes): lognormal around the
+        # traffic window, chronic stragglers seeded at the head of a
+        # permutation so the set is deterministic per (seed, fleet).
+        service = spec.round_minutes * rng.lognormal(
+            0.0, 0.5, size=n_dev)
+        n_slow = int(round(straggler_fraction * n_dev))
+        slow_ids = rng.permutation(n_dev)[:n_slow]
+        service[slow_ids] *= straggler_multiplier
+        reg = telemetry.get_registry()
+        reg.gauge("fleetsim.async_buffer_size").set(buffer_size)
+
+        version = 0
+        ring: dict[int, object] = {0: self.server_state.params}
+        heap: list = []          # (t_done, seq, device_id, version)
+        seq = 0
+        all_ids = np.arange(n_dev, dtype=np.int64)
+        wait0 = self._async_arrival_wait(rng, all_ids, 0.0)
+        for d in range(n_dev):
+            heapq.heappush(heap, (wait0[d] + service[d], seq, d, 0))
+            seq += 1
+        now = 0.0
+        arrivals = 0
+        wasted = 0
+        stale_streak: dict[int, int] = {}
+        pruned: dict[int, int] = {}   # device -> aggregation to re-admit
+        pruned_total = 0
+        base_len = len(self.history)
+        start = time.perf_counter()
+
+        def redispatch(d: int, t: float) -> None:
+            nonlocal seq
+            wait = float(self._async_arrival_wait(
+                rng, np.asarray([d], np.int64), t)[0])
+            heapq.heappush(heap, (t + wait + service[d], seq, d, version))
+            seq += 1
+
+        for agg in range(aggregations):
+            t0 = time.perf_counter()
+            # Probation re-admission first: a re-admitted device rejoins
+            # the arrival stream at the current version, clean streak.
+            for d in [d for d, until in pruned.items() if until <= agg]:
+                del pruned[d]
+                stale_streak.pop(d, None)
+                redispatch(d, now)
+            buffered: list[tuple[int, int]] = []   # (device, version)
+            discarded = 0
+            while len(buffered) < buffer_size:
+                t_done, _, d, v = heapq.heappop(heap)
+                now = max(now, t_done)
+                arrivals += 1
+                tau = version - v
+                if tau > max_staleness:
+                    # Too stale: wasted compute + uplink.  The chronic
+                    # stragglers this counts are what pruning exists to
+                    # stop paying for.
+                    discarded += 1
+                    wasted += 1
+                    reg.counter(
+                        "fleetsim.async_updates_discarded_total").inc()
+                    streak = stale_streak.get(d, 0) + 1
+                    stale_streak[d] = streak
+                    if (prune_after > 0 and streak >= prune_after
+                            and n_dev - len(pruned) - 1 >= buffer_size):
+                        pruned[d] = agg + probation
+                        pruned_total += 1
+                        reg.counter(
+                            "fleetsim.async_devices_pruned_total").inc()
+                    else:
+                        redispatch(d, now)
+                    continue
+                stale_streak.pop(d, None)
+                buffered.append((d, v))
+
+            # Fold the buffer grouped by dispatch version: every update
+            # in a group trained against the same ring snapshot, so one
+            # chunk dispatch per group reuses the compiled round
+            # programs on chunk_size-padded shapes.
+            acc = self._zero_acc()
+            stalenesses = [version - v for _, v in buffered]
+            for v in sorted({v for _, v in buffered}):
+                ids = np.asarray([d for d, dv in buffered if dv == v],
+                                 np.int64)
+                s_w = float((1.0 + (version - v)) ** -staleness_exponent)
+                padded = np.zeros(self.chunk_size, np.int64)
+                padded[:ids.shape[0]] = ids
+                keep = np.zeros(self.chunk_size, bool)
+                keep[:ids.shape[0]] = True
+                budgets = np.zeros(self.chunk_size, np.int32)
+                budgets[:ids.shape[0]] = self._budget_fn(ids).astype(
+                    np.int32)
+                cx, cy, cc = self._shard_fn(padded)
+                part = self._chunk_fn(
+                    self.base_key, ring[v], cx, cy, cc, padded,
+                    jnp.asarray(v, jnp.int32), budgets, keep)
+                wsum, total_w, loss_sum, n_comp = part
+                part = (pytrees.tree_scale(wsum, s_w), total_w * s_w,
+                        loss_sum * s_w, n_comp)
+                acc = self._fold_fn(acc, part)
+            self.server_state, metrics = self._finish_fn(
+                self.server_state, *acc)
+            out = {k: float(v) for k, v in jax.device_get(metrics).items()}
+            version += 1
+            ring[version] = self.server_state.params
+            for v in [v for v in ring if v < version - max_staleness]:
+                del ring[v]
+            for d, _ in buffered:
+                redispatch(d, now)
+
+            rec = {
+                "aggregation": base_len + agg,
+                "model_version": version,
+                "buffer_size": buffer_size,
+                "staleness_mean": float(np.mean(stalenesses)),
+                "staleness_max": int(np.max(stalenesses)),
+                "discarded": discarded,
+                "contributors": len(buffered),
+                "train_loss": out["train_loss"],
+                "total_weight": out["total_weight"],
+                "sim_time_min": now,
+                "arrival_rate_per_min": arrivals / max(now, 1e-9),
+                "agg_rate_per_min": (agg + 1) / max(now, 1e-9),
+                "wasted_updates_total": wasted,
+                "agg_time_s": time.perf_counter() - t0,
+            }
+            if prune_after > 0:
+                # Conditional keys, same convention as the socket plane:
+                # default async records stay byte-identical with the
+                # feature off.
+                rec["pruned"] = len(pruned)
+                rec["pruned_total"] = pruned_total
+            reg.counter("fleetsim.async_aggregations_total").inc()
+            self.history.append(rec)
+            if log_fn is not None:
+                log_fn(rec)
+        reg.gauge("fleetsim.async_sim_minutes").set(now)
+        reg.histogram("fleetsim.round_time_s").observe(
+            time.perf_counter() - start)
         return self.history

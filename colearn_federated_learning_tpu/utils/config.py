@@ -91,10 +91,11 @@ def validate_robustness(config: "ExperimentConfig") -> None:
             "residual to feed back"
         )
     if fed.topk_adaptive:
-        if fed.compress != "topk" or not fed.compress_feedback:
+        if (fed.compress not in ("topk", "topk8")
+                or not fed.compress_feedback):
             raise ValueError(
                 "topk_adaptive steers density off the error-feedback "
-                "residual norm, so it needs compress='topk' AND "
+                "residual norm, so it needs compress='topk'/'topk8' AND "
                 "compress_feedback=True"
             )
         if not (0.0 < fed.topk_min_fraction

@@ -136,11 +136,69 @@ INGEST_ROW_SCHEMA = {
     "bench_wall_s": float,
 }
 
+# Buffered-async throughput rows (--async-sweep): arrival-rate vs
+# straggler-tail scaling from 1k to 1M devices.  The service/arrival
+# distributions are the SAME model fleetsim's fit_async simulates
+# (diurnal-Poisson check-ins, lognormal service, a seeded fraction of
+# chronic stragglers at a fixed multiple); the sweep evaluates them
+# analytically over a deterministic device sample with a fixed-point
+# waste estimate, so the 1M point never materializes a 1M fleet.  The
+# headline columns: async folds track the ARRIVAL rate
+# (``arrival_tracking`` = folded/arrived, ``async_updates_per_min``),
+# while a sync round is bounded by the straggler TAIL
+# (``sync_round_min`` = the cohort's completion-time quantile), so
+# ``async_speedup_x`` holds at every scale — the sentinel pins it at
+# the 1M row.
+ASYNC_ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "buffer_size": int,
+    "max_staleness": int,
+    "rate_per_device_hr": float,
+    "service_mean_min": float,
+    "straggler_fraction": float,
+    "straggler_multiplier": float,
+    "arrival_rate_per_min": float,
+    "agg_rate_per_min": float,
+    "staleness_mean_est": float,
+    "waste_fraction": float,
+    "arrival_tracking": float,
+    "async_updates_per_min": float,
+    "sync_quantile": float,
+    "sync_round_min": float,
+    "sync_updates_per_min": float,
+    "async_speedup_x": float,
+    "bench_wall_s": float,
+}
+
+# Straggler-pruning gate row (--async-sweep): one MEASURED pair of
+# fit_async runs (pruned vs unpruned, same seed/fleet) — pruning must
+# waste measurably fewer too-stale updates at equal final loss.
+ASYNC_PRUNE_ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "buffer_size": int,
+    "aggregations": int,
+    "max_staleness": int,
+    "prune_after": int,
+    "probation": int,
+    "wasted_updates_unpruned": int,
+    "wasted_updates_pruned": int,
+    "waste_reduction_x": float,
+    "pruned_total": int,
+    "final_loss_unpruned": float,
+    "final_loss_pruned": float,
+    "loss_gap": float,
+    "bench_wall_s": float,
+}
+
 SCHEMAS = {
     "fleet_round": ROW_SCHEMA,
     "fleet_mask_cost": MASK_ROW_SCHEMA,
     "fleet_uplink_bytes": UPLINK_ROW_SCHEMA,
     "fleet_ingest_scaling": INGEST_ROW_SCHEMA,
+    "fleet_async": ASYNC_ROW_SCHEMA,
+    "fleet_async_prune": ASYNC_PRUNE_ROW_SCHEMA,
 }
 
 
@@ -400,6 +458,151 @@ def mask_point(devices: int, neighbors: int, group_size: int,
     }
 
 
+def async_point(devices: int, *, rate_per_device_hr: float = 2.0,
+                service_mean_min: float = 10.0,
+                straggler_fraction: float = 0.05,
+                straggler_multiplier: float = 20.0,
+                buffer_divisor: int = 16, max_staleness: int = 32,
+                sync_quantile: float = 0.98, seed: int = 0,
+                samples: int = 65536) -> dict:
+    """One buffered-async throughput row at ``devices`` fleet scale.
+
+    Evaluates fleetsim's fit_async service model analytically over a
+    deterministic ``samples``-device draw instead of materializing the
+    fleet: per-device completion window W = arrival wait (exponential at
+    the diurnal base rate) + service time (lognormal sigma=0.5 around
+    ``service_mean_min``, with a seeded ``straggler_fraction`` of
+    chronic stragglers at ``straggler_multiplier``x).
+
+    Async side: the coordinator folds arrivals as they land, so the
+    aggregation rate is (surviving arrival rate) / buffer_size; an
+    update's staleness is W x aggregation rate, and updates past
+    ``max_staleness`` versions are discarded.  Waste and aggregation
+    rate feed back on each other, so both come from a short fixed-point
+    iteration.  Sync side: a round must wait for the cohort's
+    ``sync_quantile`` completion time, which the chronic-straggler tail
+    dominates at every fleet size.  ``async_speedup_x`` is the ratio of
+    folded-update throughput, and stays flat from 1k to 1M because the
+    async plane tracks the ARRIVAL rate while the sync plane is bounded
+    by the straggler TAIL."""
+    import numpy as np
+
+    t0 = time.time()
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0xA51C]))
+    rate_per_min = rate_per_device_hr / 60.0
+    wait = rng.exponential(1.0 / rate_per_min, size=samples)
+    service = service_mean_min * rng.lognormal(0.0, 0.5, size=samples)
+    n_slow = int(round(straggler_fraction * samples))
+    slow = rng.permutation(samples)[:n_slow]
+    service[slow] *= straggler_multiplier
+    window = wait + service
+
+    buffer_size = max(32, devices // buffer_divisor)
+    arrival_rate = devices * rate_per_min
+    # Fixed point: staleness depends on the aggregation rate, which
+    # depends on how many arrivals survive the staleness cut.
+    waste = 0.0
+    agg_rate = arrival_rate / buffer_size
+    for _ in range(32):
+        waste = float(np.mean(window * agg_rate > max_staleness))
+        agg_rate = arrival_rate * (1.0 - waste) / buffer_size
+    staleness_mean = float(np.mean(
+        np.minimum(window * agg_rate, max_staleness)))
+    async_updates_per_min = arrival_rate * (1.0 - waste)
+
+    sync_round_min = float(np.quantile(window, sync_quantile))
+    sync_updates_per_min = devices * sync_quantile / sync_round_min
+
+    return {
+        "bench": "fleet_async",
+        "devices": devices,
+        "buffer_size": buffer_size,
+        "max_staleness": max_staleness,
+        "rate_per_device_hr": rate_per_device_hr,
+        "service_mean_min": service_mean_min,
+        "straggler_fraction": straggler_fraction,
+        "straggler_multiplier": straggler_multiplier,
+        "arrival_rate_per_min": round(arrival_rate, 4),
+        "agg_rate_per_min": round(agg_rate, 6),
+        "staleness_mean_est": round(staleness_mean, 3),
+        "waste_fraction": round(waste, 4),
+        "arrival_tracking": round(1.0 - waste, 4),
+        "async_updates_per_min": round(async_updates_per_min, 4),
+        "sync_quantile": sync_quantile,
+        "sync_round_min": round(sync_round_min, 3),
+        "sync_updates_per_min": round(sync_updates_per_min, 4),
+        "async_speedup_x": round(
+            async_updates_per_min / sync_updates_per_min, 3),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
+def async_prune_point(*, devices: int = 64, aggregations: int = 40,
+                      buffer_size: int = 8, max_staleness: int = 6,
+                      prune_after: int = 1, probation: int = 40,
+                      seed: int = 0) -> dict:
+    """One MEASURED straggler-pruning gate row: run fit_async twice on
+    the same seeded fleet — pruning off, then on — and report wasted
+    (too-stale, discarded) updates and tail loss for both.  The gate the
+    sentinels pin: pruning must cut waste by a real factor while the
+    final loss stays within a small gap of the unpruned run."""
+    from colearn_federated_learning_tpu import fleetsim
+    from colearn_federated_learning_tpu.utils.config import (
+        ExperimentConfig, FedConfig, ModelConfig, RunConfig)
+
+    t0 = time.time()
+    spec = fleetsim.PopulationSpec(num_devices=devices, num_classes=10,
+                                   feature_dim=32, shard_capacity=16,
+                                   label_skew=0.7, seed=seed)
+    population = fleetsim.DevicePopulation(spec)
+    config = ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=64,
+                          depth=2),
+        fed=FedConfig(strategy="fedavg", local_steps=2, batch_size=16,
+                      lr=0.05),
+        run=RunConfig(name="bench-async-prune", seed=seed))
+
+    def tail_loss(history):
+        losses = [r["train_loss"] for r in history[-5:]]
+        return sum(losses) / max(1, len(losses))
+
+    results = {}
+    for label, pa in (("unpruned", 0), ("pruned", prune_after)):
+        traffic = fleetsim.TrafficModel(fleetsim.TrafficSpec(seed=seed),
+                                        spec.num_devices)
+        sim = fleetsim.FleetSim.from_population(
+            config, population, traffic, cohort_size=8, chunk_size=16)
+        hist = sim.fit_async(aggregations, buffer_size=buffer_size,
+                             max_staleness=max_staleness, prune_after=pa,
+                             probation=probation)
+        results[label] = {
+            "wasted": int(hist[-1]["wasted_updates_total"]),
+            "loss": tail_loss(hist),
+            "pruned_total": int(hist[-1].get("pruned_total", 0)),
+        }
+    wasted_un = results["unpruned"]["wasted"]
+    wasted_pr = results["pruned"]["wasted"]
+    return {
+        "bench": "fleet_async_prune",
+        "devices": devices,
+        "buffer_size": buffer_size,
+        "aggregations": aggregations,
+        "max_staleness": max_staleness,
+        "prune_after": prune_after,
+        "probation": probation,
+        "wasted_updates_unpruned": wasted_un,
+        "wasted_updates_pruned": wasted_pr,
+        "waste_reduction_x": round(wasted_un / max(1, wasted_pr), 3),
+        "pruned_total": results["pruned"]["pruned_total"],
+        "final_loss_unpruned": round(results["unpruned"]["loss"], 5),
+        "final_loss_pruned": round(results["pruned"]["loss"], 5),
+        "loss_gap": round(
+            abs(results["pruned"]["loss"] - results["unpruned"]["loss"]),
+            5),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
 def check_schema(path: str) -> int:
     """Validate every row of a bench JSONL against the schema for its
     ``bench`` tag (CI gate)."""
@@ -480,6 +683,17 @@ def main(argv=None) -> int:
                     help="cohort size for the ingest-scaling sweep")
     ap.add_argument("--ingest-aggregators", default="1,2,4",
                     help="comma-separated aggregator counts N to sweep")
+    ap.add_argument("--async-sweep", action="store_true",
+                    help="append fleet_async rows (analytic buffered-"
+                         "async vs sync throughput over --async-devices, "
+                         "fixed-point waste estimate, no fleet "
+                         "materialized) plus ONE measured "
+                         "fleet_async_prune gate row (fit_async pruned "
+                         "vs unpruned on the same seeded 64-device "
+                         "fleet)")
+    ap.add_argument("--async-devices", default="1000,10000,100000,1000000",
+                    help="comma-separated fleet sizes for the async "
+                         "throughput sweep")
     ap.add_argument("--append", action="store_true",
                     help="append rows to --out instead of rewriting it "
                          "(e.g. --cohorts '' --mask-sweep --append adds "
@@ -512,6 +726,15 @@ def main(argv=None) -> int:
             row = ingest_point(args.ingest_devices, n, params, fold_s)
             rows.append(row)
             print(json.dumps(row))
+
+    if args.async_sweep:
+        for n in (int(x) for x in args.async_devices.split(",") if x):
+            row = async_point(n, seed=args.seed)
+            rows.append(row)
+            print(json.dumps(row))
+        row = async_prune_point(seed=args.seed)
+        rows.append(row)
+        print(json.dumps(row))
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "a" if args.append else "w") as f:

@@ -238,7 +238,7 @@ def main(argv=None) -> int:
                     help="measured rounds per configuration (after 1 warmup)")
     ap.add_argument("--cohorts", default="2,4",
                     help="comma-separated cohort sizes")
-    ap.add_argument("--schemes", default="int8,topk",
+    ap.add_argument("--schemes", default="int8,topk,topk8",
                     help="comma-separated UPLINK compress schemes, swept "
                          "at the largest cohort (the 'none' uplink "
                          "baseline is the plain downlink row)")
@@ -278,16 +278,21 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"FAIL: tp_size={tp} row avoided no gather bytes "
                 "(sharded downlink not engaged)")
-        if scheme_up == "topk":
+        if scheme_up in ("topk", "topk8"):
             if row["uplink_densify_avoided_per_round"] < n:
                 raise SystemExit(
-                    "FAIL: topk uplink row folded "
+                    f"FAIL: {scheme_up} uplink row folded "
                     f"{row['uplink_densify_avoided_per_round']} of {n} "
                     "contributions sparse (sparse-native fold not engaged)")
-            if row["uplink_reduction_x"] < 6.0:
+            # topk ships 8 bytes/kept entry; the topk8 hybrid (int8
+            # values + per-leaf scale) ~5 — it must price strictly
+            # better than plain topk at the same density.
+            floor = 6.0 if scheme_up == "topk" else 9.0
+            if row["uplink_reduction_x"] < floor:
                 raise SystemExit(
-                    "FAIL: topk uplink reduction "
-                    f"{row['uplink_reduction_x']}x < 6x vs the dense frame")
+                    f"FAIL: {scheme_up} uplink reduction "
+                    f"{row['uplink_reduction_x']}x < {floor}x vs the "
+                    "dense frame")
         return row
 
     # Downlink matrix (unchanged axes): cohorts × down-schemes × tp.

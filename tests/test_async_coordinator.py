@@ -10,6 +10,7 @@ weights, no round deadline.
 import time
 
 import numpy as np
+import pytest
 
 from colearn_federated_learning_tpu.comm.async_coordinator import (
     AsyncFederatedCoordinator,
@@ -355,6 +356,431 @@ def test_async_composes_with_topk_compression():
                 hist = coord.fit(aggregations=3)
             assert len(hist) == 3
             assert all(np.isfinite(r["train_loss"]) for r in hist)
+        finally:
+            for w in workers:
+                w.stop()
+
+
+# ===================================================================
+# PR 13: streaming-fold parity, straggler pruning, dead-pump eviction,
+# resume idempotency, lost-wakeup regression.
+# ===================================================================
+
+def _fold_params():
+    rng = np.random.default_rng(7)
+    f = lambda *s: rng.standard_normal(s).astype(np.float32)
+    return {
+        "params": {
+            "Embed_0": {"embedding": f(16, 8)},
+            "Dense_0": {"kernel": f(8, 32), "bias": f(32)},
+            "Dense_1": {"kernel": f(32, 8)},
+            "LayerNorm_0": {"scale": f(8)},
+        }
+    }
+
+
+def _tree_bytes(tree):
+    import jax
+
+    return [np.asarray(l).tobytes() for l in jax.tree.leaves(tree)]
+
+
+def _arrival_stream(n, compress=None):
+    """n (device_id, meta, payload, weight) in arrival order, with the
+    SAME device appearing twice (a slow device can land updates for two
+    model versions in one buffer — the case that forces the async
+    staging keys).  Weights are irrational-ish so the float sum is
+    order-sensitive and the bitwise compare is meaningful."""
+    import jax
+
+    from colearn_federated_learning_tpu.fed import compression
+
+    shapes = _fold_params()
+    out = []
+    for i in range(n):
+        rng = np.random.default_rng(300 + i)
+        d = jax.tree.map(
+            lambda w: rng.standard_normal(w.shape).astype(np.float32),
+            shapes)
+        dev = "dup" if i in (0, n - 1) else str(i)
+        meta = {"client_id": dev, "mean_loss": 0.3 + 0.05 * i}
+        if compress == "topk":
+            wire, cmeta = compression.compress_delta(
+                d, "topk", topk_fraction=0.2)
+            meta.update(cmeta)
+            d = wire
+        w = (1.0 + i) ** -0.5          # staleness-style discounts
+        out.append((dev, meta, d, w))
+    return out
+
+
+def _async_stage(folder, stream):
+    """Stage a stream exactly the way run_aggregation does: meta COPY
+    with a zero-padded arrival-index key, so the folder's sorted
+    finalize (order=None) IS arrival order."""
+    for idx, (dev, meta, payload, w) in enumerate(stream):
+        fmeta = dict(meta)
+        fmeta["client_id"] = f"{idx:08d}@{dev}"
+        folder.add(fmeta, payload, weight=w)
+
+
+@pytest.fixture(scope="module")
+def tp_placement():
+    import jax
+
+    from colearn_federated_learning_tpu.parallel import partition
+
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("needs the forced 8-device CPU host")
+    pl = partition.make_server_placement(
+        _fold_params(), 4, "model", "bert", devices=devs[:4])
+    assert pl is not None
+    return pl
+
+
+def test_async_fold_bitwise_parity_dense():
+    # The StreamingFolder staging the async coordinator uses must
+    # reproduce the legacy dense UpdateFolder fold BITWISE — same
+    # arrival order, duplicate device included.
+    from colearn_federated_learning_tpu.comm.aggregation import (
+        StreamingFolder,
+        UpdateFolder,
+    )
+
+    stream = _arrival_stream(5)
+    legacy = UpdateFolder(_fold_params())
+    for dev, meta, d, w in stream:
+        legacy.add(meta, d, weight=w)
+    streaming = StreamingFolder(_fold_params())
+    _async_stage(streaming, stream)
+
+    m_leg, w_leg, l_leg = legacy.mean()
+    m_str, w_str, l_str = streaming.mean()
+    assert w_leg == w_str and l_leg == l_str
+    assert _tree_bytes(m_leg) == _tree_bytes(m_str)
+
+
+def test_async_fold_bitwise_parity_topk():
+    # Same contract with topk wires: the legacy path densified each
+    # update; the async folder stages (indices, values) sparse.
+    from colearn_federated_learning_tpu.comm.aggregation import (
+        StreamingFolder,
+        UpdateFolder,
+    )
+    import jax
+
+    stream = _arrival_stream(5, compress="topk")
+    legacy = UpdateFolder(_fold_params())
+    for dev, meta, wire, w in stream:
+        legacy.add(dict(meta), jax.tree.map(np.copy, wire), weight=w)
+    streaming = StreamingFolder(_fold_params())
+    _async_stage(streaming, [
+        (dev, meta, jax.tree.map(np.copy, wire), w)
+        for dev, meta, wire, w in stream
+    ])
+
+    m_leg, w_leg, _ = legacy.mean()
+    m_str, w_str, _ = streaming.mean()
+    assert w_leg == w_str
+    assert streaming.densify_avoided == 5
+    assert _tree_bytes(m_leg) == _tree_bytes(m_str)
+
+
+def test_async_fold_bitwise_parity_tp_sharded(tp_placement):
+    # tp-sharded async fold (per-shard sparse scatter) == the legacy
+    # replicated dense fold, bitwise on host reads.
+    from colearn_federated_learning_tpu.comm.aggregation import (
+        StreamingFolder,
+        UpdateFolder,
+    )
+    import jax
+
+    from colearn_federated_learning_tpu.parallel import partition
+
+    stream = _arrival_stream(4, compress="topk")
+    legacy = UpdateFolder(_fold_params())
+    for dev, meta, wire, w in stream:
+        legacy.add(dict(meta), jax.tree.map(np.copy, wire), weight=w)
+    sharded = StreamingFolder(tp_placement.shapes_tree(),
+                              placement=tp_placement)
+    _async_stage(sharded, [
+        (dev, meta, jax.tree.map(np.copy, wire), w)
+        for dev, meta, wire, w in stream
+    ])
+
+    m_leg, w_leg, _ = legacy.mean()
+    m_shd, w_shd, _ = sharded.mean()
+    assert w_leg == w_shd
+    for leaf in __import__("jax").tree.leaves(m_shd):
+        assert isinstance(leaf, jax.Array)
+    host = partition.host_tree(m_shd)
+    assert _tree_bytes(m_leg) == _tree_bytes(host)
+
+
+def test_async_prune_requires_health_dir():
+    with pytest.raises(ValueError, match="health ledger"):
+        AsyncFederatedCoordinator(
+            _config(), "127.0.0.1", 1, prune_after=3,
+        )
+    with pytest.raises(ValueError, match="probation"):
+        AsyncFederatedCoordinator(
+            _config(), "127.0.0.1", 1, probation=0,
+        )
+
+
+def test_async_update_pruning_policy():
+    # Unit-level policy oracle (no sockets): streak trigger, score
+    # trigger with the latency-EWMA term, the buffer-size floor, and
+    # probation re-admission.
+    import threading
+    import types
+
+    from colearn_federated_learning_tpu import telemetry
+    from colearn_federated_learning_tpu.telemetry.health import DeviceHealth
+
+    upd = AsyncFederatedCoordinator._update_pruning
+    reg = telemetry.get_registry()
+    pruned_stale = reg.counter("async.devices_pruned_total",
+                               labels={"reason": "stale"})
+    pruned_score = reg.counter("async.devices_pruned_total",
+                               labels={"reason": "score"})
+    readmit = reg.counter("async.devices_readmitted_total")
+    p0s, p0c, r0 = pruned_stale.value, pruned_score.value, readmit.value
+
+    mk = lambda ids: [types.SimpleNamespace(device_id=d) for d in ids]
+    ns = types.SimpleNamespace(
+        _pruned={}, _stale_streak={"a": 5, "b": 5, "c": 1},
+        prune_after=3, prune_score=0.0, probation=2, buffer_size=2,
+        _health_lock=threading.Lock(), health=None,
+        trainers=mk(["a", "b", "c"]), _state_lock=threading.Lock())
+    upd(ns, 0)
+    # Both a and b qualify, but pruning both would leave 1 active pump
+    # < buffer_size 2: only the worst (tie broken by id) is paused.
+    assert ns._pruned == {"a": 2}
+    assert pruned_stale.value - p0s == 1
+
+    # Probation end: a is re-admitted with a clean streak.
+    ns._stale_streak["a"] = 5
+    upd(ns, 2)
+    assert "a" not in ns._pruned
+    assert "a" not in ns._stale_streak
+    assert readmit.value - r0 == 1
+
+    # Score trigger: ledger failure score plus multiples-of-median
+    # latency EWMA above 1x.
+    slow, fast = DeviceHealth("s"), DeviceHealth("f")
+    slow.counts["deadline_miss"] = 4          # score 12
+    slow.lat_ewma, fast.lat_ewma = 9.0, 1.0   # median 5 -> +0.8
+    ns2 = types.SimpleNamespace(
+        _pruned={}, _stale_streak={},
+        prune_after=0, prune_score=12.5, probation=4, buffer_size=1,
+        _health_lock=threading.Lock(),
+        health=types.SimpleNamespace(
+            devices=lambda: {"s": slow, "f": fast}),
+        trainers=mk(["s", "f"]), _state_lock=threading.Lock())
+    upd(ns2, 0)
+    assert ns2._pruned == {"s": 4}
+    assert pruned_score.value - p0c == 1
+
+
+def test_async_pruning_pauses_pump_and_readmits(tmp_path):
+    import dataclasses
+
+    cfg = _config(num_clients=3)
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, health_dir=str(tmp_path / "health")))
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            with AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=1,
+                want_evaluator=False, prune_after=2, probation=3,
+            ) as coord:
+                coord.enroll(min_devices=3, timeout=20.0)
+                rec0 = coord.fit(aggregations=1)[0]
+                # Pruning keys are stamped whenever the feature is on;
+                # health keys whenever the ledger is attached.
+                assert rec0["pruned"] == []
+                assert rec0["health_devices"] >= 1
+                # Chronic too-stale streak -> the pump is paused.  A
+                # FRESH fold from "0" legitimately clears the streak
+                # (that's the policy), so re-arm it until an
+                # aggregation lands without "0" contributing.
+                rec1 = None
+                for _ in range(12):
+                    coord._stale_streak["0"] = 99
+                    rec1 = coord.run_aggregation()
+                    if rec1["pruned"] == ["0"]:
+                        break
+                assert rec1 is not None and rec1["pruned"] == ["0"]
+                # While pruned the pump is paused: at most one in-flight
+                # pre-prune update from "0" can still fold.
+                from_zero = 0
+                recs = [coord.run_aggregation() for _ in range(2)]
+                for r in recs:
+                    from_zero += r["contributors"].count("0")
+                assert from_zero <= 1
+                assert all(r["pruned"] == ["0"] for r in recs)
+                # Probation ended: re-admitted, pump live again.
+                rec4 = coord.run_aggregation()
+                assert rec4["pruned"] == []
+                assert "0" not in coord._stale_streak
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_async_default_records_have_no_feature_keys():
+    # Byte-identical default records: no pruning, eviction, or health
+    # keys unless those planes are on.
+    cfg = _config(num_clients=3)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            with AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=2,
+                want_evaluator=False,
+            ) as coord:
+                coord.enroll(min_devices=3, timeout=20.0)
+                rec = coord.run_aggregation()
+            for key in ("pruned", "evicted", "skipped_quorum",
+                        "health_devices", "health_worst_device"):
+                assert key not in rec, key
+        finally:
+            for w in workers:
+                w.stop()
+
+
+def test_async_dead_pump_eviction_and_reenroll():
+    import dataclasses
+
+    from colearn_federated_learning_tpu import telemetry
+
+    cfg = _config(num_clients=4)
+    cfg = cfg.replace(run=dataclasses.replace(cfg.run, evict_after=2))
+    evict_ctr = telemetry.get_registry().counter(
+        "fed.devices_evicted_total")
+    e0 = evict_ctr.value
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        revived = None
+        try:
+            with AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=1,
+                request_timeout=1.0, want_evaluator=False,
+            ) as coord:
+                coord.enroll(min_devices=3, timeout=20.0)
+                # Kill device 0's worker: its pump fails evict_after
+                # consecutive dispatches, then stops and revokes the
+                # trainer (instead of retrying forever).
+                workers[0].stop()
+                deadline = time.time() + 60.0
+                recs = []
+                while "0" not in coord.evicted and time.time() < deadline:
+                    recs.append(coord.run_aggregation())
+                assert coord.evicted == ["0"]
+                assert "0" not in {t.device_id for t in coord.trainers}
+                assert evict_ctr.value - e0 == 1
+                # Exactly one record carries the eviction key.
+                recs += [coord.run_aggregation()]
+                tagged = [r for r in recs if "evicted" in r]
+                assert len(tagged) == 1 and tagged[0]["evicted"] == ["0"]
+
+                # Elastic re-enrollment restarts the pump under the
+                # same device name.
+                revived = DeviceWorker(cfg, 0, broker.host,
+                                       broker.port).start()
+                admitted = []
+                while not admitted and time.time() < deadline:
+                    admitted = coord.refresh_membership()
+                assert admitted == ["0"]
+                contributors = set()
+                while "0" not in contributors and time.time() < deadline:
+                    contributors.update(
+                        coord.run_aggregation()["contributors"])
+                assert "0" in contributors
+        finally:
+            for w in workers:
+                w.stop()
+            if revived is not None:
+                revived.stop()
+
+
+def test_async_restore_is_idempotent(tmp_path):
+    # Double restore, and restore on an instance that already charged
+    # the accountant, must both land on the checkpoint's exact budget.
+    import dataclasses
+
+    cfg = _config(num_clients=3, dp_clip=1.0, dp_noise_multiplier=1.0)
+    cfg = cfg.replace(run=dataclasses.replace(
+        cfg.run, checkpoint_dir=str(tmp_path / "ckpt")))
+    with MessageBroker() as broker:
+        with AsyncFederatedCoordinator(
+            cfg, broker.host, broker.port, buffer_size=2,
+            want_evaluator=False,
+        ) as coord:
+            for i, z in enumerate([1.1, 0.9, 1.4]):
+                coord.accountant.step(1, sampling_rate=1.0,
+                                      noise_multiplier=z)
+                coord.history.append({"aggregation": i, "dp_z_eff": z})
+            coord.version = 3
+            eps = coord.accountant.epsilon()
+            coord.save_checkpoint()
+
+        with AsyncFederatedCoordinator(
+            cfg, broker.host, broker.port, buffer_size=2,
+            want_evaluator=False,
+        ) as c2:
+            assert c2.restore_checkpoint() == 3
+            assert c2.accountant.epsilon() == eps
+            # Retry the restore: replay must not compose on top.
+            assert c2.restore_checkpoint() == 3
+            assert c2.accountant.epsilon() == eps
+            assert c2.accountant.steps == 3
+            # Resume AFTER this instance aggregated (its accountant
+            # already holds charges): still the checkpoint budget.
+            c2.accountant.step(1, sampling_rate=1.0,
+                               noise_multiplier=0.8)
+            assert c2.restore_checkpoint() == 3
+            assert c2.accountant.epsilon() == eps
+
+
+def test_async_version_cv_poll_not_load_bearing():
+    # Regression for the lost-wakeup window: with the cv poll inflated
+    # to minutes, progress must come entirely from the aggregator's
+    # notify (held across the version increment) and shutdown from
+    # close()'s notify — if either were missing, the pumps would sleep
+    # out the poll and the aggregation would time out.
+    cfg = _config(num_clients=3)
+    with MessageBroker() as broker:
+        workers = [
+            DeviceWorker(cfg, i, broker.host, broker.port).start()
+            for i in range(3)
+        ]
+        try:
+            coord = AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=2,
+                request_timeout=30.0, want_evaluator=False,
+            )
+            coord._cv_poll_s = 300.0
+            with coord:
+                coord.enroll(min_devices=3, timeout=20.0)
+                hist = coord.fit(aggregations=3)
+                t_close = time.perf_counter()
+            close_s = time.perf_counter() - t_close
+            assert len(hist) == 3
+            assert hist[-1]["model_version"] == 3
+            assert close_s < 10.0, close_s
         finally:
             for w in workers:
                 w.stop()

@@ -398,3 +398,65 @@ def test_cli_fleetsim_reports_compile_counts(capsys):
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["compiles"]["chunk"] == 1
     assert summary["compiles"]["finish"] == 1
+
+
+# --------------------------------------------------------- buffered async --
+def test_fit_async_converges_with_one_compile_per_shape():
+    reg = telemetry.get_registry()
+    before_aggs = reg.counter("fleetsim.async_aggregations_total").value
+    fs = make_fleet(num_devices=32, cohort=8, chunk=8)
+    hist = fs.fit_async(10, buffer_size=8, max_staleness=8)
+    assert len(hist) == 10
+    # Versions advance by exactly one per aggregation — the WAL/monotone
+    # invariant the chaos gate checks on the socket plane.
+    assert [r["model_version"] for r in hist] == list(range(1, 11))
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+    for rec in hist:
+        assert rec["contributors"] == 8 == rec["buffer_size"]
+        assert 0 <= rec["staleness_mean"] <= rec["staleness_max"] <= 8
+        assert rec["sim_time_min"] > 0
+        # Pruning is off: the feature-gated keys must be ABSENT so the
+        # default record schema is byte-identical.
+        assert "pruned" not in rec and "pruned_total" not in rec
+    # Pad-to-chunk keeps the jitted trio at one compile each.
+    assert fs.compile_counts == {"chunk": 1, "finish": 1, "fold": 1}
+    assert (reg.counter("fleetsim.async_aggregations_total").value
+            == before_aggs + 10)
+
+
+def test_fit_async_staleness_discard_and_pruning_cut_waste():
+    # Same seeded fleet twice: the 5% chronic stragglers (20x service
+    # time) blow past max_staleness every flight, so the unpruned run
+    # keeps folding money into discarded updates; pruning pauses them
+    # after the first discard and probation keeps them out.
+    runs = {}
+    for label, prune_after in (("unpruned", 0), ("pruned", 1)):
+        fs = make_fleet(num_devices=32, cohort=8, chunk=8)
+        hist = fs.fit_async(30, buffer_size=8, max_staleness=6,
+                            prune_after=prune_after, probation=30,
+                            straggler_fraction=0.25,
+                            straggler_multiplier=4.0)
+        runs[label] = hist
+    wasted_un = runs["unpruned"][-1]["wasted_updates_total"]
+    wasted_pr = runs["pruned"][-1]["wasted_updates_total"]
+    assert wasted_un > 0, "straggler population produced no discards"
+    assert wasted_pr < wasted_un
+    assert runs["pruned"][-1]["pruned_total"] >= 1
+    # Pruned-run records carry the feature-gated keys.
+    assert all("pruned" in r and "pruned_total" in r for r in runs["pruned"])
+    # Equal-quality gate (loose tier-1 flavor of the bench sentinel).
+    import math
+    for hist in runs.values():
+        assert math.isfinite(hist[-1]["train_loss"])
+
+
+def test_fit_async_validates_inputs():
+    fs = make_fleet(num_devices=16, cohort=8, chunk=8)
+    with pytest.raises(ValueError, match="buffer"):
+        fs.fit_async(2, buffer_size=0)
+    with pytest.raises(ValueError, match="buffer"):
+        fs.fit_async(2, buffer_size=17)   # > num_devices
+    learner = FederatedLearner(tiny_config())
+    from_learner = fleetsim.FleetSim.from_learner(learner, chunk_size=4)
+    with pytest.raises(NotImplementedError, match="traffic"):
+        from_learner.fit_async(2, buffer_size=2)

@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL014), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL015), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -821,6 +821,63 @@ def test_cl014_suppression(tmp_path):
             lag = time.monotonic() - t0  # colearn: noqa(CL014)
             return lag
     """, relpath="pkg/comm/worker.py", rules=["CL014"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl015_flags_bare_sleep_in_retry_loop(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+
+        def request(self, header, retry):
+            for attempt in range(retry.max_retries):
+                try:
+                    return self.ask(header)
+                except OSError:
+                    time.sleep(retry.delay(attempt))
+    """, relpath="pkg/comm/transport.py", rules=["CL015"])
+    assert rule_ids(res) == ["CL015"]
+    assert res.exit_code == 1
+
+
+def test_cl015_allows_event_wait_and_one_shot_sleep(tmp_path):
+    # The sanctioned idiom: backoff waits on the owner's stop event.
+    res = run_lint(tmp_path, """
+        def pump(self):
+            while not self._stop.is_set():
+                if not self.dispatch():
+                    self._stop.wait(0.2)
+    """, relpath="pkg/comm/worker.py", rules=["CL015"])
+    assert res.findings == []
+    # A one-shot sleep outside any loop (startup grace) is not a
+    # backoff — CL015 only polices loops.
+    res = run_lint(tmp_path, """
+        import time
+
+        def start(self):
+            self.spawn()
+            time.sleep(0.1)
+    """, relpath="pkg/comm/broker.py", rules=["CL015"])
+    assert res.findings == []
+    # Sleeps in loops OUTSIDE comm/: other planes (bench scripts,
+    # fleetsim clocks) keep their own idioms.
+    res = run_lint(tmp_path, """
+        import time
+
+        def poll(path):
+            while not path.exists():
+                time.sleep(0.5)
+    """, relpath="pkg/faults/watch.py", rules=["CL015"])
+    assert res.findings == []
+
+
+def test_cl015_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+
+        def settle(self):
+            for _ in range(3):
+                time.sleep(0.01)  # colearn: noqa(CL015)
+    """, relpath="pkg/comm/transport.py", rules=["CL015"])
     assert res.findings == [] and res.suppressed == 1
 
 

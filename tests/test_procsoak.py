@@ -46,6 +46,23 @@ def test_kill_spec_accepts_aggregator_targets():
         KillSpec("aggregator:x", after_round=0)
 
 
+def test_kill_spec_accepts_async_coordinator_target():
+    # The buffered-async plane's singleton: same restart contract as the
+    # sync coordinator (nobody else can fold, so the fleet would hang).
+    KillSpec("async-coordinator", after_round=1)
+    with pytest.raises(ValueError, match="restart"):
+        KillSpec("async-coordinator", after_round=0, restart=False)
+
+
+def test_run_async_soak_rejects_tiny_budgets():
+    from colearn_federated_learning_tpu.faults.procsoak import run_async_soak
+
+    # < 4 aggregations cannot fit a mid-run kill plus a meaningful tail
+    # for the loss-parity gate.
+    with pytest.raises(ValueError, match="aggregations"):
+        run_async_soak(aggregations=3)
+
+
 def test_canned_schedule_scales_with_run_length():
     short = canned_kill_schedule(3, 2)
     assert [k.target for k in short] == ["coordinator"]
@@ -160,3 +177,30 @@ def test_proc_soak_broker_sigkill_heals(tmp_path):
     dumps = flight.load_flight_dumps(str(tmp_path / "flight"))
     by_pid = {d.get("pid"): d for d in dumps if "error" not in d}
     assert by_pid[s["kills"][0]["pid"]]["role"] == "broker"
+
+
+@pytest.mark.slow
+def test_async_soak_coordinator_sigkill_resumes(tmp_path):
+    """The buffered-async acceptance run: 3 workers, a real SIGKILL to
+    the async coordinator mid-aggregation, relaunch with ``--resume`` —
+    versions stay monotonic across both incarnations, the RDP accountant
+    replay reproduces the final epsilon exactly (no double-charge), and
+    the faulted run's tail loss lands within tolerance of a same-seed
+    kill-free baseline."""
+    from colearn_federated_learning_tpu.faults.procsoak import run_async_soak
+
+    s = run_async_soak(aggregations=5, n_workers=3,
+                       workdir=str(tmp_path), round_timeout=120.0,
+                       timeout_s=600.0)
+    assert s["exit_code"] == 0
+    assert s["baseline_exit_code"] == 0
+    assert s["aggregations_run"] >= 5
+    assert s["version_monotonic"]
+    assert s["resumed"] >= 1
+    assert s["coordinator_incarnations"] == 2
+    assert s["dp_replay_ok"], (s["dp_epsilon"], s["dp_epsilon_replayed"])
+    assert s["loss_gap_ok"], s["loss_gap"]
+    assert s["postmortem_attributed"]
+    assert s["health_ledger_ok"]
+    assert s["fault_retries"] >= 1        # the FaultPlan flap landed
+    assert s["flight_missing"] == []
