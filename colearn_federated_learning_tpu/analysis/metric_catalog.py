@@ -97,12 +97,18 @@ COUNTERS = (
     "async.devices_pruned_total",      # labeled {reason=straggler|...}
     "async.devices_readmitted_total",  # probation expiry re-admissions
     "fed.devices_evicted_total",       # dead-pump eviction, labeled {device=}
+    # staleness observatory (comm/async_coordinator.py)
+    "async.contribution_mass",       # Σ(1+τ)^-α, labeled {outcome=folded|...}
+    "async.pump_stalls_total",       # dispatch slower than timeout/2, {device=}
+    "async.buffer_resizes_total",    # auto-K changed the fold threshold
     # fleet simulation (fleetsim/sim.py)
     "fleetsim.rounds_total",
     "fleetsim.clients_trained_total",
     "fleetsim.async_aggregations_total",
     "fleetsim.async_updates_discarded_total",  # too-stale at fold time
     "fleetsim.async_devices_pruned_total",
+    "fleetsim.async_contribution_mass",   # labeled {outcome=folded|discarded}
+    "fleetsim.async_buffer_resizes_total",  # auto-K resizes (virtual clock)
     "fleetsim.bytes_up_est_total",     # wire-codec frame estimate, uplink
     "fleetsim.bytes_down_est_total",   # wire-codec frame estimate, downlink
     "fleetsim.bytes_gather_avoided_est_total",  # sharded-downlink estimate
@@ -141,6 +147,13 @@ GAUGES = (
     # aggregator tier visibility (comm/coordinator.py → `colearn top`)
     "comm.agg_heartbeat_age_s",      # labeled {agg=<id>}: announce staleness
     "comm.agg_slice_devices",        # labeled {agg=<id>}: dispatch slice size
+    # staleness observatory (comm/async_coordinator.py, telemetry/arrival.py)
+    "async.buffer_target",           # K in force for the current aggregation
+    "async.buffer_occupancy",        # updates folded into the open buffer
+    "async.pending_updates",         # arrived-but-unfolded queue depth
+    "async.pumps",                   # labeled {state=wait|train|retry|...}
+    "async.arrival_rate_per_s",      # seeded-EWMA; labeled {device=} children
+    "fleetsim.async_arrival_rate_per_min",  # same estimator, virtual clock
     # health ledger exports (telemetry/health.py export_gauges)
     "health.devices_tracked",
     "health.device_score",           # labeled {device=<id>}: offender rank
@@ -155,6 +168,8 @@ HISTOGRAMS = (
     "fed.round_time_s",
     "fed.phase_time_s",      # labeled {phase=broadcast_collect|aggregate|...}
     "async.agg_time_s",
+    "async.staleness",       # labeled {outcome=folded|discarded}: τ per update
+    "fleetsim.async_staleness",      # same, on the simulated clock
     "fleetsim.round_time_s",
     "comm.agg_fold_time_s",  # labeled {agg=<id>}: middle-tier slice folds
 )

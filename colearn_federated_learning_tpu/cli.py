@@ -522,6 +522,19 @@ def _coordinator_resume(coord) -> None:
     }), file=sys.stderr)
 
 
+def _async_buffer_arg(value: str):
+    """``--async-buffer``: 0 (off), a positive int K, or ``auto`` —
+    adaptive K sized from the observed arrival rate
+    (telemetry/arrival.py)."""
+    if value == "auto":
+        return "auto"
+    try:
+        return int(value)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'auto', got {value!r}") from None
+
+
 def cmd_coordinate(args: argparse.Namespace) -> int:
     from colearn_federated_learning_tpu.comm.coordinator import (
         FederatedCoordinator,
@@ -590,6 +603,7 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
             prune_after=args.async_prune_after,
             prune_score=args.async_prune_score,
             probation=args.async_probation,
+            observe=args.async_observe,
         )
         if recorder is not None:
             recorder.attach_tracer(coord.tracer)
@@ -851,27 +865,48 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
     if args.trace_dir:
         sim.tracer.enabled = True
     if args.async_buffer:
+        from colearn_federated_learning_tpu import telemetry
+
         history = sim.fit_async(
             args.rounds, buffer_size=args.async_buffer,
             max_staleness=args.async_max_staleness,
             prune_after=args.async_prune_after,
             probation=args.async_probation,
+            observe=args.async_observe,
             log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr))
         last = history[-1]
+        # Arrival tracking: what fraction of arrived updates were folded
+        # (1 == the fold plane keeps up with the arrival stream; every
+        # too-stale discard is tracked work lost).
+        arrived = last["arrival_rate_per_min"] * last["sim_time_min"]
+        folded = max(0.0, arrived - last["wasted_updates_total"])
         summary = {
             "devices": spec.num_devices,
-            "buffer_size": args.async_buffer,
+            "buffer_size": last["buffer_size"],
             "aggregations": len(history),
             "model_version": last["model_version"],
             "sim_minutes": last["sim_time_min"],
             "arrival_rate_per_min": last["arrival_rate_per_min"],
             "agg_rate_per_min": last["agg_rate_per_min"],
+            "arrival_tracking": folded / max(arrived, 1e-9),
             "staleness_mean": (
                 sum(r["staleness_mean"] for r in history) / len(history)),
             "wasted_updates": last["wasted_updates_total"],
             "train_loss": last["train_loss"],
             "compiles": sim.compile_counts,
         }
+        # Staleness tail over every FOLDED update this run (the labeled
+        # histogram the observatory keeps) — the distribution, not just
+        # the per-aggregation mean.
+        hs = telemetry.get_registry().histogram(
+            "fleetsim.async_staleness",
+            labels={"outcome": "folded"}).summary()
+        if hs.get("count"):
+            summary["staleness_p50"] = hs["p50"]
+            summary["staleness_p90"] = hs["p90"]
+            summary["staleness_p99"] = hs["p99"]
+        if args.async_buffer == "auto":
+            summary["buffer_auto"] = True
         if args.async_prune_after:
             summary["pruned"] = last["pruned"]
             summary["pruned_total"] = last["pruned_total"]
@@ -1214,11 +1249,19 @@ def main(argv: list[str] | None = None) -> int:
     p_coord.add_argument("--mud-allowed-types", default=None,
                          help="comma-separated device types admitted to "
                               "the federation (MUD colearn:device-type)")
-    p_coord.add_argument("--async-buffer", type=int, default=0,
+    p_coord.add_argument("--async-buffer", type=_async_buffer_arg,
+                         default=0,
                          help="> 0 switches to buffered-asynchronous "
                               "aggregation (FedBuff-style): apply the "
                               "staleness-weighted mean every N updates "
-                              "instead of running synchronous rounds")
+                              "instead of running synchronous rounds; "
+                              "'auto' sizes N from the observed arrival "
+                              "rate (target fold cadence)")
+    p_coord.add_argument("--async-observe", action="store_true",
+                         help="stamp observatory keys (contribution "
+                              "mass, arrival rate, staleness tail) into "
+                              "async aggregation records (implied by "
+                              "--async-buffer auto)")
     p_coord.add_argument("--async-prune-after", type=int, default=0,
                          help="pause a device's dispatch pump after this "
                               "many CONSECUTIVE too-stale discards "
@@ -1334,12 +1377,19 @@ def main(argv: list[str] | None = None) -> int:
                          help="write the sweep's span trace (fleet_round/"
                               "train_chunks/train_chunk) as a Chrome-trace "
                               "JSON here; read with `colearn trace-summary`")
-    p_fleet.add_argument("--async-buffer", type=int, default=0,
+    p_fleet.add_argument("--async-buffer", type=_async_buffer_arg,
+                         default=0,
                          help="> 0 runs the buffered-ASYNC simulation "
                               "instead of sync rounds: fold every N "
                               "arrival-ordered completions with staleness "
                               "weighting (FleetSim.fit_async); --rounds "
-                              "then counts aggregations")
+                              "then counts aggregations; 'auto' sizes N "
+                              "from the observed arrival rate")
+    p_fleet.add_argument("--async-observe", action="store_true",
+                         help="async mode: stamp observatory keys "
+                              "(staleness tail, contribution mass, EWMA "
+                              "arrival rate) into records (implied by "
+                              "--async-buffer auto)")
     p_fleet.add_argument("--async-max-staleness", type=int, default=10,
                          help="async mode: discard updates staler than "
                               "this many versions (wasted compute)")

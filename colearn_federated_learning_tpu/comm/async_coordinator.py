@@ -44,6 +44,28 @@ re-admitted after a probation window of aggregations.  Pruning never
 shrinks the active pump set below ``buffer_size`` (the buffer must stay
 fillable), and all of it is off — with byte-identical aggregation
 records — unless explicitly enabled.
+
+The staleness observatory (PR 14) makes the plane observable and then
+load-bearing:
+
+- **version lineage:** every enqueued update carries its
+  ``dispatch_train`` span context, and the aggregator folds it inside a
+  ``fold_update`` span PARENTED on that context — so one Perfetto trace
+  per update shows dispatch → worker train → buffer-wait → fold, with τ
+  and the owning ``async.aggregate`` span id in the args (the PR 12
+  tree-stitch pattern, per update instead of per tier);
+- **staleness & pump telemetry:** a labeled
+  ``async.staleness{outcome=folded|discarded}`` histogram, buffer
+  occupancy / per-pump-state gauges, a seeded-EWMA arrival-rate
+  estimator (telemetry/arrival.py, fleet + per-device gauges), and
+  contribution-mass accounting (Σ(1+τ)^-exp folded vs. discarded);
+- **adaptive buffering:** ``buffer_size="auto"`` retunes K from the
+  observed fleet arrival rate before every aggregation (K = rate ×
+  target fold interval, clamped to [1, trainers]) — the ROADMAP's
+  "K driven by the observed arrival rate instead of a flag".
+
+Observatory record keys (mass/arrival/staleness-tail) are stamped only
+when ``observe`` (or auto-K) is on; default records stay byte-identical.
 """
 
 from __future__ import annotations
@@ -82,7 +104,7 @@ class AsyncFederatedCoordinator:
         config: ExperimentConfig,
         broker_host: str,
         broker_port: int,
-        buffer_size: int = 4,
+        buffer_size=4,
         staleness_exponent: float = 0.5,
         max_staleness: int = 10,
         request_timeout: float = 60.0,
@@ -91,15 +113,36 @@ class AsyncFederatedCoordinator:
         prune_after: int = 0,
         prune_score: float = 0.0,
         probation: int = 8,
+        observe: bool = False,
+        auto_interval_s: float = 2.0,
     ):
         """``prune_after``: consecutive too-stale discards before a
         device's pump is paused (0 disables streak pruning).
         ``prune_score``: health-ledger score threshold that pauses a pump
         (0 disables score pruning).  ``probation``: aggregations a pruned
         device sits out before re-admission.  Either pruning trigger
-        requires ``run.health_dir`` — the ledger is the score source."""
-        if buffer_size < 1:
-            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        requires ``run.health_dir`` — the ledger is the score source.
+        ``buffer_size``: an int, or ``"auto"`` to size K from the
+        observed arrival rate (K = rate × ``auto_interval_s``, the target
+        fold cadence, re-evaluated before every aggregation).
+        ``observe``: stamp observatory keys (contribution mass, arrival
+        rate, staleness tail) into aggregation records; implied by
+        auto-K, off by default so default records stay byte-identical."""
+        if isinstance(buffer_size, str):
+            if buffer_size != "auto":
+                raise ValueError(
+                    f"buffer_size must be an int >= 1 or 'auto', "
+                    f"got {buffer_size!r}")
+            self.auto_buffer = True
+            buffer_size = 4       # warm-start K until the estimator is live
+        else:
+            self.auto_buffer = False
+            if buffer_size < 1:
+                raise ValueError(
+                    f"buffer_size must be >= 1, got {buffer_size}")
+        if auto_interval_s <= 0:
+            raise ValueError(
+                f"auto_interval_s must be > 0, got {auto_interval_s}")
         if prune_after < 0 or prune_score < 0:
             raise ValueError("prune_after/prune_score must be >= 0")
         if probation < 1:
@@ -139,6 +182,21 @@ class AsyncFederatedCoordinator:
         # versions is not a federation round.  0 disables.
         self.min_cohort_fraction = config.fed.min_cohort_fraction
         self.buffer_size = buffer_size
+        self.observe_records = bool(observe) or self.auto_buffer
+        self.auto_interval_s = float(auto_interval_s)
+        # Seeded-EWMA arrival-rate estimator (telemetry/arrival.py): the
+        # pumps observe every successful dispatch on the monotonic clock;
+        # auto-K and the per-aggregation gauges read the fleet rate.
+        self.arrival = telemetry.ArrivalEstimator()
+        # Per-pump state for the pump-state gauges (advisory — pumps
+        # update their own slot; the aggregator counts them per agg).
+        self._pump_state: dict[str, str] = {}
+        # Cumulative fold/discard counts: auto-K scales the target
+        # interval by the fold fraction (only FOLDED arrivals fill the
+        # buffer, so sizing off raw arrivals overshoots when staleness
+        # discards bite).
+        self._folded_total = 0
+        self._discarded_total = 0
         self.staleness_exponent = staleness_exponent
         self.max_staleness = max_staleness
         self.request_timeout = request_timeout
@@ -304,6 +362,7 @@ class AsyncFederatedCoordinator:
         cli = self._clients[dev.device_id]
         last_v = -1
         while not self._stop.is_set():
+            self._pump_state[dev.device_id] = "wait"
             with self._version_cv:
                 while self.version == last_v and not self._stop.is_set():
                     # The timeout is a belt-and-braces poll, NOT the wake
@@ -319,13 +378,16 @@ class AsyncFederatedCoordinator:
                 # predicted dropout — dispatching would burn its compute
                 # on an update destined for the staleness discard.  Idle
                 # on the stop event until probation re-admits it.
+                self._pump_state[dev.device_id] = "pruned"
                 self._stop.wait(0.25)
                 continue
             v, _params_np, body = self._snapshot()
+            self._pump_state[dev.device_id] = "train"
             t_req = time.perf_counter()
             try:
                 with self.tracer.span("dispatch_train",
-                                      device=dev.device_id, version=v):
+                                      device=dev.device_id,
+                                      version=v) as dispatch_sp:
                     header, delta = cli.request(
                         protocol.attach_trace(
                             {"op": "train", "round": v},
@@ -339,6 +401,7 @@ class AsyncFederatedCoordinator:
             except Exception:
                 if self._stop.is_set():
                     return
+                self._pump_state[dev.device_id] = "retry"
                 self.failures[dev.device_id] = (
                     self.failures.get(dev.device_id, 0) + 1
                 )
@@ -371,10 +434,22 @@ class AsyncFederatedCoordinator:
                 self._stop.wait(0.2)
                 continue
             self._fail_streak.pop(dev.device_id, None)
-            self._record_health(dev.device_id, round=v,
-                                latency_s=time.perf_counter() - t_req)
+            lat = time.perf_counter() - t_req
+            self._record_health(dev.device_id, round=v, latency_s=lat)
+            if lat > 0.5 * self.request_timeout:
+                # Pump stall: the device answered, but burned most of the
+                # dispatch timeout budget — the leading indicator the
+                # health plane wants before the retry/eviction symptoms.
+                telemetry.get_registry().counter(
+                    "async.pump_stalls_total",
+                    labels={"device": str(dev.device_id)}).inc()
+                self._record_health(dev.device_id, pump_stall=1)
+            self.arrival.observe(dev.device_id, now=time.monotonic())
             last_v = v
-            self._results.put((dev.device_id, header["meta"], delta, v))
+            # The update travels with its dispatch span context (version
+            # lineage) and its arrival time (buffer-wait attribution).
+            self._results.put((dev.device_id, header["meta"], delta, v,
+                               dispatch_sp.context, time.perf_counter()))
 
     def _record_health(self, device_id: str, **kw) -> None:
         """Thread-safe ledger append (pumps + aggregator share it)."""
@@ -397,6 +472,7 @@ class AsyncFederatedCoordinator:
         if cli is not None:
             cli.close()
         self._fail_streak.pop(dev.device_id, None)
+        self._pump_state[dev.device_id] = "evicted"
         telemetry.get_registry().counter("fed.devices_evicted_total").inc()
         self._record_health(dev.device_id, eviction=1)
         threading.current_thread().name = (
@@ -458,6 +534,11 @@ class AsyncFederatedCoordinator:
             self._pruned[d] = agg_idx + self.probation
             reg.counter("async.devices_pruned_total",
                         labels={"reason": reason}).inc()
+            # Attribute the prune to the device in the health ledger
+            # (CLIP's predicted dropout IS a health event).
+            if self.health is not None:
+                with self._health_lock:
+                    self.health.record(str(d), prune=1)
 
     def _health_async_feed(self) -> dict:
         """Per-aggregation ledger flush + merged fleet view (the sync
@@ -517,6 +598,26 @@ class AsyncFederatedCoordinator:
             StreamingFolder,
         )
 
+        reg = telemetry.get_registry()
+        if self.auto_buffer:
+            # Adaptive K — the telemetry made load-bearing: size the
+            # buffer so a fold lands about every auto_interval_s at the
+            # observed fleet arrival rate, clamped to [1, trainers]
+            # (each device contributes at most one update per version,
+            # so a larger buffer could never fill).
+            seen = self._folded_total + self._discarded_total
+            fold_frac = self._folded_total / seen if seen else 1.0
+            k = self.arrival.recommend_buffer(
+                self.auto_interval_s * max(fold_frac, 0.05), lo=1,
+                hi=max(1, len(self.trainers)), current=self.buffer_size)
+            # Slew-limit the resize: the rate estimate trails load
+            # swings by one buffer fill, so jumping straight to the
+            # recommendation overshoots the cadence band it chases.
+            k = max(max(1, self.buffer_size // 2),
+                    min(k, max(2, self.buffer_size * 3 // 2)))
+            if k != self.buffer_size:
+                reg.counter("async.buffer_resizes_total").inc()
+                self.buffer_size = k
         if self.buffer_size > len(self.trainers):
             raise ValueError(
                 f"buffer_size {self.buffer_size} exceeds the "
@@ -525,7 +626,7 @@ class AsyncFederatedCoordinator:
                 "buffer could never fill"
             )
         self._start_dispatchers()
-        reg = telemetry.get_registry()
+        reg.gauge("async.buffer_target").set(float(self.buffer_size))
         t0 = time.perf_counter()
         # StreamingFolder (the uplink fast path + sharded server): topk
         # replies stage their wire (indices, values) sparse — O(k) per
@@ -541,77 +642,135 @@ class AsyncFederatedCoordinator:
         contributors: list[str] = []
         weights: list[float] = []
         discarded = 0
+        mass_folded = 0.0
+        mass_discarded = 0.0
+        fold_span_ids: list[str] = []
         stall_deadline = t0 + 2.0 * self.request_timeout
-        with self.tracer.span("collect_updates",
-                              buffer_size=self.buffer_size) as collect_sp:
-            while len(staleness) < self.buffer_size:
-                try:
-                    dev_id, meta, delta, v = self._results.get(
-                        timeout=max(0.1, stall_deadline - time.perf_counter())
-                    )
-                except queue.Empty:
-                    raise RuntimeError(
-                        f"no update arrived within "
-                        f"{2 * self.request_timeout:.0f}s "
-                        f"({len(staleness)}/{self.buffer_size} buffered); "
-                        f"device failures: {dict(self.failures)}"
-                    ) from None
-                stall_deadline = (time.perf_counter()
-                                  + 2.0 * self.request_timeout)
-                tau = self.version - v
-                if tau > self.max_staleness:
-                    # Per-device attribution: the labeled child rolls up
-                    # into the unlabeled family, so aggregate readers
-                    # (soak deltas) keep working.
-                    discarded += 1
-                    reg.counter("async.updates_discarded_stale",
-                                labels={"device": str(dev_id)}).inc()
-                    self._stale_streak[dev_id] = (
-                        self._stale_streak.get(dev_id, 0) + 1)
-                    self._record_health(dev_id, round=self.version,
-                                        deadline_miss=1)
-                    continue
-                self._stale_streak.pop(dev_id, None)
-                w = (float(meta.get("weight", 1.0))
-                     * (1.0 + tau) ** (-self.staleness_exponent))
-                fmeta = dict(meta)
-                fmeta["client_id"] = f"{len(staleness):08d}@{dev_id}"
-                folder.add(fmeta, delta, weight=w)
-                staleness.append(tau)
-                contributors.append(dev_id)
-                weights.append(w)
+        # The async.aggregate span owns this aggregation's timeline; each
+        # consumed update additionally records a fold_update span PARENTED
+        # on its dispatch context — version lineage: the span joins that
+        # update's dispatch→train trace, carrying τ, outcome, and
+        # buffer-wait — and is cross-linked to this span by id
+        # (link_agg / link_folds, the PR 12 tree-stitch flow pattern).
+        with self.tracer.span("async.aggregate", version=self.version,
+                              buffer_size=self.buffer_size) as agg_sp:
+            with self.tracer.span(
+                    "collect_updates",
+                    buffer_size=self.buffer_size) as collect_sp:
+                while len(staleness) < self.buffer_size:
+                    try:
+                        dev_id, meta, delta, v, dctx, t_arr = (
+                            self._results.get(timeout=max(
+                                0.1,
+                                stall_deadline - time.perf_counter()))
+                        )
+                    except queue.Empty:
+                        raise RuntimeError(
+                            f"no update arrived within "
+                            f"{2 * self.request_timeout:.0f}s "
+                            f"({len(staleness)}/{self.buffer_size} "
+                            f"buffered); "
+                            f"device failures: {dict(self.failures)}"
+                        ) from None
+                    stall_deadline = (time.perf_counter()
+                                      + 2.0 * self.request_timeout)
+                    tau = self.version - v
+                    stale_w = (1.0 + tau) ** (-self.staleness_exponent)
+                    wait_s = time.perf_counter() - t_arr
+                    if tau > self.max_staleness:
+                        # Per-device attribution: the labeled child rolls
+                        # up into the unlabeled family, so aggregate
+                        # readers (soak deltas) keep working.
+                        discarded += 1
+                        self._discarded_total += 1
+                        mass_discarded += stale_w
+                        reg.counter("async.updates_discarded_stale",
+                                    labels={"device": str(dev_id)}).inc()
+                        reg.counter(
+                            "async.contribution_mass",
+                            labels={"outcome": "discarded"}).inc(stale_w)
+                        reg.histogram(
+                            "async.staleness",
+                            labels={"outcome": "discarded"}).observe(
+                                float(tau))
+                        with self.tracer.span(
+                                "fold_update", parent=dctx,
+                                device=str(dev_id), tau=tau, version=v,
+                                applied_version=self.version,
+                                outcome="discarded",
+                                buffer_wait_s=wait_s,
+                                link_agg=agg_sp.span_id):
+                            pass
+                        self._stale_streak[dev_id] = (
+                            self._stale_streak.get(dev_id, 0) + 1)
+                        self._record_health(dev_id, round=self.version,
+                                            deadline_miss=1)
+                        continue
+                    self._stale_streak.pop(dev_id, None)
+                    w = float(meta.get("weight", 1.0)) * stale_w
+                    fmeta = dict(meta)
+                    fmeta["client_id"] = f"{len(staleness):08d}@{dev_id}"
+                    with self.tracer.span(
+                            "fold_update", parent=dctx,
+                            device=str(dev_id), tau=tau, version=v,
+                            applied_version=self.version,
+                            outcome="folded", buffer_wait_s=wait_s,
+                            link_agg=agg_sp.span_id) as fold_sp:
+                        folder.add(fmeta, delta, weight=w)
+                    fold_span_ids.append(fold_sp.span_id)
+                    self._folded_total += 1
+                    mass_folded += stale_w
+                    reg.counter("async.contribution_mass",
+                                labels={"outcome": "folded"}).inc(stale_w)
+                    reg.histogram(
+                        "async.staleness",
+                        labels={"outcome": "folded"}).observe(float(tau))
+                    staleness.append(tau)
+                    contributors.append(dev_id)
+                    weights.append(w)
+                    reg.gauge("async.buffer_occupancy").set(
+                        float(len(staleness)))
 
-        with self.tracer.span("apply_update",
-                              version=self.version) as apply_sp:
-            mean_delta, total_w, mean_loss = folder.mean()
-            # Quorum over DISTINCT contributors (a slow federation can fill
-            # the buffer with one device's updates across versions).  A
-            # sub-quorum buffer is discarded — but the version still
-            # advances, or every dispatcher pump would block forever on a
-            # model that can never change.
-            quorum = (max(1, math.ceil(self.min_cohort_fraction
-                                       * len(self.trainers)))
-                      if self.min_cohort_fraction > 0 else 0)
-            skipped_quorum = bool(quorum) and len(set(contributors)) < quorum
-            if skipped_quorum:
-                telemetry.get_registry().counter(
-                    "fed.rounds_skipped_quorum").inc()
-                mean_delta = None
-                mean_loss = float("nan")
-            with self._state_lock:
-                if mean_delta is not None:
-                    self.server_state = strategies.server_update(
-                        self.server_state, mean_delta, self.config.fed
-                    )
-                # The version bump happens under BOTH locks: _state_lock
-                # keeps (server_state, version) consistent for _snapshot,
-                # and holding _version_cv across increment+notify closes
-                # the lost-wakeup window a pump would otherwise hit between
-                # reading version and calling wait() (today's 0.1 s poll
-                # would mask it, but the poll must not be load-bearing).
-                with self._version_cv:
-                    self.version += 1
-                    self._version_cv.notify_all()
+            with self.tracer.span("apply_update",
+                                  version=self.version) as apply_sp:
+                mean_delta, total_w, mean_loss = folder.mean()
+                # Quorum over DISTINCT contributors (a slow federation
+                # can fill the buffer with one device's updates across
+                # versions).  A sub-quorum buffer is discarded — but the
+                # version still advances, or every dispatcher pump would
+                # block forever on a model that can never change.
+                quorum = (max(1, math.ceil(self.min_cohort_fraction
+                                           * len(self.trainers)))
+                          if self.min_cohort_fraction > 0 else 0)
+                skipped_quorum = (bool(quorum)
+                                  and len(set(contributors)) < quorum)
+                if skipped_quorum:
+                    telemetry.get_registry().counter(
+                        "fed.rounds_skipped_quorum").inc()
+                    mean_delta = None
+                    mean_loss = float("nan")
+                with self._state_lock:
+                    if mean_delta is not None:
+                        self.server_state = strategies.server_update(
+                            self.server_state, mean_delta, self.config.fed
+                        )
+                    # The version bump happens under BOTH locks:
+                    # _state_lock keeps (server_state, version) consistent
+                    # for _snapshot, and holding _version_cv across
+                    # increment+notify closes the lost-wakeup window a
+                    # pump would otherwise hit between reading version and
+                    # calling wait() (today's 0.1 s poll would mask it,
+                    # but the poll must not be load-bearing).
+                    with self._version_cv:
+                        self.version += 1
+                        self._version_cv.notify_all()
+            agg_sp.attrs["folded"] = len(staleness)
+            agg_sp.attrs["discarded"] = discarded
+            agg_sp.attrs["link_folds"] = fold_span_ids
+        reg.gauge("async.buffer_occupancy").set(0.0)
+        reg.gauge("async.pending_updates").set(float(self._results.qsize()))
+        self._export_pump_gauges(reg)
+        self.arrival.export_gauges(reg, "async.arrival_rate_per_s")
         agg_idx = len(self.history)
         reg.counter("async.aggregations_total").inc()
         # (Too-stale discards were already counted at the discard site —
@@ -633,6 +792,18 @@ class AsyncFederatedCoordinator:
             "phase_collect_s": collect_sp.duration_s,
             "phase_apply_s": apply_sp.duration_s,
         }
+        if self.observe_records:
+            # Observatory keys — only when observe/auto-K is on, so
+            # default aggregation records stay byte-identical.
+            rec["mass_folded"] = round(mass_folded, 6)
+            rec["mass_discarded"] = round(mass_discarded, 6)
+            rec["arrival_rate_per_s"] = round(self.arrival.rate(), 6)
+            hs = reg.histogram("async.staleness",
+                               labels={"outcome": "folded"}).summary()
+            if hs.get("count"):
+                rec["staleness_p50"] = hs["p50"]
+                rec["staleness_p90"] = hs["p90"]
+                rec["staleness_p99"] = hs["p99"]
         if quorum:
             # Key only present when the quorum feature is on, so default
             # aggregation records stay byte-identical.
@@ -654,6 +825,18 @@ class AsyncFederatedCoordinator:
             rec.update(telemetry.health_record_keys(fleet))
         self.history.append(rec)
         return rec
+
+    def _export_pump_gauges(self, reg) -> None:
+        """Per-pump-state gauge children (``async.pumps{state=...}``):
+        every known state is set each aggregation — including zeros — so
+        a scrape always sees the full partition, not just states some
+        pump happened to visit."""
+        states: dict[str, int] = {}
+        for st in list(self._pump_state.values()):
+            states[st] = states.get(st, 0) + 1
+        for st in ("wait", "train", "retry", "pruned", "evicted"):
+            reg.gauge("async.pumps", labels={"state": st}).set(
+                float(states.get(st, 0)))
 
     def _charge_privacy(self, weights: list[float],
                         contributors: list[str]) -> float:

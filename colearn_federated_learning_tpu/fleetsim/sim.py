@@ -548,7 +548,7 @@ class FleetSim:
     def fit_async(
         self,
         aggregations: int,
-        buffer_size: int = 32,
+        buffer_size=32,
         *,
         staleness_exponent: float = 0.5,
         max_staleness: int = 10,
@@ -556,6 +556,8 @@ class FleetSim:
         probation: int = 8,
         straggler_fraction: float = 0.05,
         straggler_multiplier: float = 20.0,
+        observe: bool = False,
+        auto_interval_min: Optional[float] = None,
         log_fn=None,
     ) -> list[dict]:
         """Buffered-asynchronous simulation (FedBuff semantics over the
@@ -582,21 +584,50 @@ class FleetSim:
         ``fleet_async_prune`` bench gate).  Groups the buffer by
         dispatch version and reuses the round-path chunk/fold/finish
         programs, so the compile-once invariant holds (chunk shapes stay
-        ``chunk_size``-padded)."""
+        ``chunk_size``-padded).
+
+        ``buffer_size="auto"`` sizes K from the seeded-EWMA arrival-rate
+        estimator before every aggregation (K = observed rate × fold
+        fraction × ``auto_interval_min``, the target fold cadence;
+        default ``round_minutes``; resizes slew-limited to ±50%) — the
+        diurnal traffic model makes the rate swing, and auto-K keeps the
+        fold cadence in band instead of letting a fixed K's cadence
+        (and the stragglers' realized τ) swing with it.  ``observe`` stamps observatory keys (staleness
+        tail, contribution mass, EWMA arrival rate) into records;
+        implied by auto-K, off by default so default async records stay
+        byte-identical."""
         import heapq
 
         if self._traffic is None:
             raise NotImplementedError(
                 "fit_async needs the traffic model: build the sim with "
                 "FleetSim.from_population")
-        if buffer_size < 1:
-            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
         n_dev = self.num_devices
+        auto_buffer = isinstance(buffer_size, str)
+        if auto_buffer:
+            if buffer_size != "auto":
+                raise ValueError(
+                    f"buffer_size must be an int >= 1 or 'auto', "
+                    f"got {buffer_size!r}")
+            buffer_size = min(8, n_dev)   # warm-start K
+        elif buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
         if buffer_size > n_dev:
             raise ValueError(
                 f"buffer_size {buffer_size} exceeds the {n_dev}-device "
                 "fleet — the buffer could never fill")
+        if buffer_size > self.chunk_size:
+            raise ValueError(
+                f"buffer_size {buffer_size} exceeds chunk_size "
+                f"{self.chunk_size} — the version-grouped fold pads "
+                "each group to one compiled chunk dispatch")
+        observe = bool(observe) or auto_buffer
         spec = self._traffic.spec
+        if auto_interval_min is None:
+            auto_interval_min = spec.round_minutes
+        # Arrival-rate estimator on the VIRTUAL clock (sim minutes) —
+        # rates come out per sim-minute, the same unit as the records.
+        est = telemetry.ArrivalEstimator()
         rng = np.random.default_rng(
             np.random.SeedSequence([self.config.run.seed, 0xA51C]))
         # Per-device service time (sim minutes): lognormal around the
@@ -643,12 +674,43 @@ class FleetSim:
                 del pruned[d]
                 stale_streak.pop(d, None)
                 redispatch(d, now)
+            if auto_buffer:
+                # Retune K to the observed arrival rate: one fold per
+                # auto_interval_min, clamped to the active (un-pruned)
+                # fleet — only that many updates can be in flight while
+                # the buffer fills.  Only FOLDED arrivals fill the
+                # buffer, so the target interval is scaled by the
+                # observed fold fraction — sizing K off raw arrivals
+                # overshoots exactly when staleness discards bite, and
+                # the realized cadence drifts out of the band.
+                # K is also clamped to chunk_size: the version-grouped
+                # fold pads each group to ONE compiled chunk dispatch,
+                # so a buffer wider than the chunk could overflow a
+                # group.
+                fold_frac = 1.0 - wasted / arrivals if arrivals else 1.0
+                k = est.recommend_buffer(
+                    auto_interval_min * max(fold_frac, 0.05), lo=1,
+                    hi=max(1, min(self.chunk_size, n_dev - len(pruned))),
+                    current=buffer_size)
+                # Slew-limit the resize: the rate estimate trails the
+                # diurnal swing by one fill, so jumping straight to the
+                # recommendation overshoots the band it is chasing.
+                k = int(np.clip(k, max(1, buffer_size // 2),
+                                max(2, buffer_size * 3 // 2)))
+                if k != buffer_size:
+                    reg.counter(
+                        "fleetsim.async_buffer_resizes_total").inc()
+                    buffer_size = k
+                reg.gauge("fleetsim.async_buffer_size").set(buffer_size)
             buffered: list[tuple[int, int]] = []   # (device, version)
             discarded = 0
+            mass_folded = 0.0
+            mass_discarded = 0.0
             while len(buffered) < buffer_size:
                 t_done, _, d, v = heapq.heappop(heap)
                 now = max(now, t_done)
                 arrivals += 1
+                est.observe(str(d), now=now)
                 tau = version - v
                 if tau > max_staleness:
                     # Too stale: wasted compute + uplink.  The chronic
@@ -656,6 +718,15 @@ class FleetSim:
                     # stop paying for.
                     discarded += 1
                     wasted += 1
+                    s_w = float((1.0 + tau) ** -staleness_exponent)
+                    mass_discarded += s_w
+                    reg.counter(
+                        "fleetsim.async_contribution_mass",
+                        labels={"outcome": "discarded"}).inc(s_w)
+                    reg.histogram(
+                        "fleetsim.async_staleness",
+                        labels={"outcome": "discarded"}).observe(
+                            float(tau))
                     reg.counter(
                         "fleetsim.async_updates_discarded_total").inc()
                     streak = stale_streak.get(d, 0) + 1
@@ -670,6 +741,13 @@ class FleetSim:
                         redispatch(d, now)
                     continue
                 stale_streak.pop(d, None)
+                s_w = float((1.0 + tau) ** -staleness_exponent)
+                mass_folded += s_w
+                reg.counter("fleetsim.async_contribution_mass",
+                            labels={"outcome": "folded"}).inc(s_w)
+                reg.histogram("fleetsim.async_staleness",
+                              labels={"outcome": "folded"}).observe(
+                                  float(tau))
                 buffered.append((d, v))
 
             # Fold the buffer grouped by dispatch version: every update
@@ -723,6 +801,21 @@ class FleetSim:
                 "wasted_updates_total": wasted,
                 "agg_time_s": time.perf_counter() - t0,
             }
+            reg.gauge("fleetsim.async_arrival_rate_per_min").set(
+                est.rate())
+            if observe:
+                # Observatory keys — only when observe/auto-K is on, so
+                # default async records stay byte-identical.
+                rec["arrival_rate_ewma_per_min"] = round(est.rate(), 6)
+                rec["mass_folded"] = round(mass_folded, 6)
+                rec["mass_discarded"] = round(mass_discarded, 6)
+                hs = reg.histogram(
+                    "fleetsim.async_staleness",
+                    labels={"outcome": "folded"}).summary()
+                if hs.get("count"):
+                    rec["staleness_p50"] = hs["p50"]
+                    rec["staleness_p90"] = hs["p90"]
+                    rec["staleness_p99"] = hs["p99"]
             if prune_after > 0:
                 # Conditional keys, same convention as the socket plane:
                 # default async records stay byte-identical with the

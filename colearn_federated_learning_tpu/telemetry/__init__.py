@@ -16,7 +16,9 @@ Public surface:
 - :mod:`.flight` — crash flight recorder (heartbeat ring-buffer dumps,
   ``colearn postmortem`` merge with the round WAL);
 - :mod:`.health` — durable per-device health ledger (straggler
-  attribution, latency sketches, ``colearn health`` renderer).
+  attribution, latency sketches, ``colearn health`` renderer);
+- :mod:`.arrival` — seeded-EWMA arrival-rate estimation (fleet +
+  per-device) feeding the async observatory and ``--async-buffer auto``.
 """
 
 from colearn_federated_learning_tpu.telemetry.tracer import (  # noqa: F401
@@ -61,6 +63,9 @@ from colearn_federated_learning_tpu.telemetry.health import (  # noqa: F401
     health_record_keys,
     load_health,
     render_health,
+)
+from colearn_federated_learning_tpu.telemetry.arrival import (  # noqa: F401
+    ArrivalEstimator,
 )
 from colearn_federated_learning_tpu.telemetry.flight import (  # noqa: F401
     FlightRecorder,

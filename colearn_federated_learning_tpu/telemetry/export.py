@@ -146,6 +146,11 @@ def summarize_trace(doc: dict, root: str = "round") -> str:
     if root == "round" and "round" not in by_name and (
             "fleet_round" in by_name):
         root = "fleet_round"
+    if root == "round" and "round" not in by_name and (
+            "async.aggregate" in by_name):
+        # Buffered-async traces have no sync rounds; percentages read as
+        # "share of aggregation wall time" instead.
+        root = "async.aggregate"
     roots = by_name.get(root, [])
     if roots:
         denom = sum(sp.duration_s for sp in roots)
@@ -204,6 +209,41 @@ def summarize_trace(doc: dict, root: str = "round") -> str:
             lines.append(
                 f"fleetsim sweep: {cohort} client(s) at "
                 f"{cohort / chunk_t:.0f} clients/s through the chunk loop")
+    # Buffered-async runs: the observatory's version-lineage spans.  Each
+    # fold_update is parented on its update's dispatch_train context, so
+    # "stitched" counts how many folds joined a dispatch→train trace.
+    aggs = by_name.get("async.aggregate", [])
+    folds = by_name.get("fold_update", [])
+    if aggs or folds:
+        lines.append("")
+        if aggs:
+            agg_t = max(sum(sp.duration_s for sp in aggs), 1e-12)
+            k_mean = (sum(int(sp.attrs.get("buffer_size") or 0)
+                          for sp in aggs) / len(aggs))
+            lines.append(
+                f"async plane: {len(aggs)} aggregation(s) at "
+                f"{len(aggs) / agg_t:.2f} folds/s (K mean {k_mean:.1f})")
+        if folds:
+            folded = [sp for sp in folds
+                      if sp.attrs.get("outcome") == "folded"]
+            stitched = sum(1 for sp in folds if sp.parent_id)
+            lines.append(
+                f"async lineage: {len(folded)} update(s) folded, "
+                f"{len(folds) - len(folded)} discarded; "
+                f"{stitched}/{len(folds)} stitched to dispatch spans")
+            taus = sorted(float(sp.attrs.get("tau") or 0.0)
+                          for sp in folded)
+            if taus:
+                def _q(p: float) -> float:
+                    return taus[min(len(taus) - 1, int(p * len(taus)))]
+
+                waits = [float(sp.attrs.get("buffer_wait_s") or 0.0)
+                         for sp in folded]
+                lines.append(
+                    f"async staleness: p50 {_q(0.50):.0f}   "
+                    f"p90 {_q(0.90):.0f}   p99 {_q(0.99):.0f}   "
+                    f"mean buffer wait "
+                    f"{sum(waits) / len(waits) * 1e3:.1f} ms")
     metrics = doc.get("otherData", {}).get("metrics")
     if metrics:
         lines.append("")

@@ -30,8 +30,11 @@ from typing import Optional
 from colearn_federated_learning_tpu.telemetry import registry as _metrics
 
 # Event-count fields a ledger line may carry, in render order.
+# ``prune`` / ``pump_stall`` are the async-plane feeds (a paused pump and
+# a dispatch that burned most of its timeout budget, per device) — old
+# ledgers without them load as zeros via ``from_dict``'s defaults.
 COUNT_FIELDS = ("deadline_miss", "retry", "corrupt_frame", "eviction",
-                "secure_dropout")
+                "secure_dropout", "prune", "pump_stall")
 
 _EWMA_ALPHA = 0.2
 _MAX_SAMPLES = 256
@@ -93,11 +96,14 @@ class DeviceHealth:
     def score(self) -> float:
         """Offender ranking: weighted failure count.  Evictions are the
         terminal symptom, deadline misses the leading one; retries are
-        the cheapest noise."""
+        the cheapest noise.  Async-plane feeds slot in between: a prune
+        is a predicted dropout (nearly an eviction), a pump stall a
+        near-miss of the dispatch timeout."""
         c = self.counts
         return (5.0 * c["eviction"] + 3.0 * c["deadline_miss"]
+                + 3.0 * c["prune"]
                 + 2.0 * c["corrupt_frame"] + 2.0 * c["secure_dropout"]
-                + 1.0 * c["retry"])
+                + 1.0 * c["retry"] + 1.0 * c["pump_stall"])
 
     def to_dict(self) -> dict:
         out: dict = {"device_id": self.device_id, "rounds": self.rounds}
@@ -357,17 +363,18 @@ def render_health(devices: dict, top: int = 10) -> str:
     ranked = sorted(devices.values(),
                     key=lambda d: (-d.score(), -(d.lat_ewma or 0.0),
                                    d.device_id))
-    lines.append("top offenders (score = 5*evict + 3*miss + 2*corrupt "
-                 "+ 2*dropout + retry)")
+    lines.append("top offenders (score = 5*evict + 3*miss + 3*prune "
+                 "+ 2*corrupt + 2*dropout + retry + stall)")
     lines.append("  device   score  miss retry corrupt evict dropout"
-                 "   lat ewma")
+                 " prune stall   lat ewma")
     for dev in ranked[:top]:
         c = dev.counts
         ewma = f"{dev.lat_ewma:.3f}s" if dev.lat_ewma is not None else "-"
         lines.append(
             f"  {dev.device_id:<8} {dev.score():>5.0f} {c['deadline_miss']:>5}"
             f" {c['retry']:>5} {c['corrupt_frame']:>7} {c['eviction']:>5}"
-            f" {c['secure_dropout']:>7} {ewma:>10}")
+            f" {c['secure_dropout']:>7} {c['prune']:>5} {c['pump_stall']:>5}"
+            f" {ewma:>10}")
     all_samples: list = []
     for dev in devices.values():
         all_samples.extend(dev.lat_samples)

@@ -468,6 +468,55 @@ def render_top(snapshot: dict, prev: Optional[dict] = None,
                 f"   partials {row.get('partials', 0.0):>6.0f}")
         lines.append(f"  failovers        {failovers:>12.0f}")
         lines.append(f"  heartbeats expired{expired:>11.0f}")
+    # Async plane (the staleness observatory): shown only when the
+    # buffered-async coordinator — or fleetsim's async mode — exported
+    # something; flat sync snapshots keep the classic layout.
+    async_aggs = (val("async.aggregations_total")
+                  or val("fleetsim.async_aggregations_total"))
+    stale = (snapshot.get("async.staleness")
+             or snapshot.get("fleetsim.async_staleness"))
+    if not (isinstance(stale, dict) and stale.get("count")):
+        stale = None
+    if async_aggs or stale:
+        lines.append("")
+        lines.append("async plane")
+        aps = (rate("async.aggregations_total")
+               or rate("fleetsim.async_aggregations_total"))
+        lines.append(f"  aggregations     {async_aggs:>12.0f}"
+                     + (f"   ({aps:.3f}/s)" if aps is not None else ""))
+        buf_k = (val("async.buffer_target")
+                 or val("fleetsim.async_buffer_size"))
+        if buf_k:
+            lines.append(f"  buffer K         {buf_k:>12.0f}")
+        arr_s = val("async.arrival_rate_per_s")
+        if arr_s:
+            lines.append(f"  arrival rate     {arr_s:>12.3f}/s")
+        arr_min = val("fleetsim.async_arrival_rate_per_min")
+        if arr_min:
+            lines.append(f"  arrival rate     {arr_min:>12.3f}/min")
+        discards = (val("async.updates_discarded_stale")
+                    or val("fleetsim.async_updates_discarded_total"))
+        lines.append(f"  stale discards   {discards:>12.0f}")
+        if stale:
+            lines.append(
+                f"  staleness        p50 {stale.get('p50', 0.0):.1f}   "
+                f"p90 {stale.get('p90', 0.0):.1f}   "
+                f"p99 {stale.get('p99', 0.0):.1f}")
+        mass_f = (val("async.contribution_mass{outcome=folded}")
+                  or val("fleetsim.async_contribution_mass"
+                         "{outcome=folded}"))
+        mass_d = (val("async.contribution_mass{outcome=discarded}")
+                  or val("fleetsim.async_contribution_mass"
+                         "{outcome=discarded}"))
+        if mass_f or mass_d:
+            lines.append(f"  mass folded      {mass_f:>12.2f}"
+                         f"   discarded {mass_d:.2f}")
+        pump_rows = [
+            f"{st} {val(f'async.pumps{{state={st}}}'):.0f}"
+            for st in ("wait", "train", "retry", "pruned", "evicted")
+            if snapshot.get(f"async.pumps{{state={st}}}") is not None]
+        if pump_rows:
+            lines.append("  pumps            " + "   ".join(pump_rows))
     compiles = val("telemetry.compile_total")
     recompiles = val("telemetry.recompile_total")
     if compiles or recompiles:

@@ -192,6 +192,36 @@ ASYNC_PRUNE_ROW_SCHEMA = {
     "bench_wall_s": float,
 }
 
+# Adaptive-buffering gate row (--async-sweep): one MEASURED sweep of
+# fit_async runs on the same seeded fleet — fixed buffer sizes vs
+# ``buffer_size="auto"`` (K sized per aggregation from the seeded-EWMA
+# arrival-rate estimator).  "Tracking" is the fold-cadence band: the
+# fraction of realized fold intervals inside [target/2, 2x target] —
+# fold cadence proportional to the arrival rate IS what tracking
+# arrivals means for a buffered-async server (folded/arrived, by
+# contrast, is trivially maximized by the largest possible K).  The
+# diurnal arrival swing carries any fixed K out of the band; auto-K
+# must stay in it at least as well as the best fixed K, at equal loss.
+ASYNC_AUTOK_ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "aggregations": int,
+    "max_staleness": int,
+    "target_interval_min": float,
+    "fixed_ks": str,
+    "best_fixed_k": int,
+    "tracking_auto": float,
+    "tracking_best_fixed": float,
+    "tracking_margin": float,
+    "final_loss_auto": float,
+    "final_loss_best_fixed": float,
+    "loss_gap": float,
+    "buffer_k_min_auto": int,
+    "buffer_k_max_auto": int,
+    "arrival_rate_per_min": float,
+    "bench_wall_s": float,
+}
+
 SCHEMAS = {
     "fleet_round": ROW_SCHEMA,
     "fleet_mask_cost": MASK_ROW_SCHEMA,
@@ -199,6 +229,7 @@ SCHEMAS = {
     "fleet_ingest_scaling": INGEST_ROW_SCHEMA,
     "fleet_async": ASYNC_ROW_SCHEMA,
     "fleet_async_prune": ASYNC_PRUNE_ROW_SCHEMA,
+    "fleet_async_autok": ASYNC_AUTOK_ROW_SCHEMA,
 }
 
 
@@ -603,6 +634,88 @@ def async_prune_point(*, devices: int = 64, aggregations: int = 40,
     }
 
 
+def async_autok_point(*, devices: int = 64, aggregations: int = 120,
+                      max_staleness: int = 6, fixed_ks=(4, 8, 16, 32),
+                      target_interval_min: float = 10.0,
+                      seed: int = 0) -> dict:
+    """One MEASURED adaptive-buffering gate row: run fit_async across a
+    fixed-K sweep and once with ``buffer_size="auto"`` on the same
+    seeded fleet.  Tracking = fraction of realized fold intervals
+    inside the target cadence band [target/2, 2x target]; 120
+    aggregations span most of a diurnal cycle, so the arrival-rate
+    swing carries every fixed K out of the band for part of the run
+    while auto-K follows the measured rate.  The ``fleet_async_autok``
+    sentinels pin tracking_margin >= 0 (auto at least matches the best
+    fixed K) and loss_gap <= 0.01 (at equal model quality)."""
+    from colearn_federated_learning_tpu import fleetsim
+    from colearn_federated_learning_tpu.utils.config import (
+        ExperimentConfig, FedConfig, ModelConfig, RunConfig)
+
+    t0 = time.time()
+    spec = fleetsim.PopulationSpec(num_devices=devices, num_classes=10,
+                                   feature_dim=32, shard_capacity=16,
+                                   label_skew=0.7, seed=seed)
+    population = fleetsim.DevicePopulation(spec)
+    config = ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=64,
+                          depth=2),
+        fed=FedConfig(strategy="fedavg", local_steps=2, batch_size=16,
+                      lr=0.05),
+        run=RunConfig(name="bench-async-autok", seed=seed))
+
+    def tail_loss(history):
+        losses = [r["train_loss"] for r in history[-5:]]
+        return sum(losses) / max(1, len(losses))
+
+    def tracking(history):
+        times = [0.0] + [r["sim_time_min"] for r in history]
+        ivs = [b - a for a, b in zip(times, times[1:])]
+        in_band = sum(1 for iv in ivs
+                      if target_interval_min / 2.0 <= iv
+                      <= target_interval_min * 2.0)
+        return in_band / max(1, len(ivs))
+
+    def run(buffer_size):
+        traffic = fleetsim.TrafficModel(fleetsim.TrafficSpec(seed=seed),
+                                        spec.num_devices)
+        sim = fleetsim.FleetSim.from_population(
+            config, population, traffic, cohort_size=32, chunk_size=32)
+        return sim.fit_async(aggregations, buffer_size=buffer_size,
+                             max_staleness=max_staleness,
+                             auto_interval_min=target_interval_min)
+
+    fixed = {}
+    for k in fixed_ks:
+        hist = run(k)
+        fixed[k] = {"tracking": tracking(hist), "loss": tail_loss(hist)}
+    best_k = max(fixed, key=lambda k: fixed[k]["tracking"])
+    auto_hist = run("auto")
+    auto_tracking = tracking(auto_hist)
+    auto_loss = tail_loss(auto_hist)
+    auto_ks = [r["buffer_size"] for r in auto_hist]
+    return {
+        "bench": "fleet_async_autok",
+        "devices": devices,
+        "aggregations": aggregations,
+        "max_staleness": max_staleness,
+        "target_interval_min": target_interval_min,
+        "fixed_ks": ",".join(str(k) for k in fixed_ks),
+        "best_fixed_k": int(best_k),
+        "tracking_auto": round(auto_tracking, 4),
+        "tracking_best_fixed": round(fixed[best_k]["tracking"], 4),
+        "tracking_margin": round(
+            auto_tracking - fixed[best_k]["tracking"], 4),
+        "final_loss_auto": round(auto_loss, 5),
+        "final_loss_best_fixed": round(fixed[best_k]["loss"], 5),
+        "loss_gap": round(abs(auto_loss - fixed[best_k]["loss"]), 5),
+        "buffer_k_min_auto": int(min(auto_ks)),
+        "buffer_k_max_auto": int(max(auto_ks)),
+        "arrival_rate_per_min": round(
+            auto_hist[-1]["arrival_rate_per_min"], 4),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
 def check_schema(path: str) -> int:
     """Validate every row of a bench JSONL against the schema for its
     ``bench`` tag (CI gate)."""
@@ -733,6 +846,9 @@ def main(argv=None) -> int:
             rows.append(row)
             print(json.dumps(row))
         row = async_prune_point(seed=args.seed)
+        rows.append(row)
+        print(json.dumps(row))
+        row = async_autok_point(seed=args.seed)
         rows.append(row)
         print(json.dumps(row))
 

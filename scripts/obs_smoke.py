@@ -31,8 +31,23 @@ A second **tree phase** then runs the same federation through a
   (``fed_phase_time_s{phase=...}``) that satisfy the same exposition
   grammar.
 
+A third **async phase** (also runnable alone: ``obs_smoke.py async``,
+the CI ``async-soak`` job's observability step) runs a REAL buffered-
+async federation (broker + 3 workers + ``coordinate --async-buffer 2
+--async-observe``) and asserts the staleness observatory end to end:
+
+- the mid-run scrape carries the labeled staleness histogram
+  (``colearn_async_staleness{...outcome=...}``) and the arrival-rate
+  gauge, all passing the exposition grammar;
+- the coordinator's Chrome trace stitches dispatch -> train -> fold per
+  update: every ``fold_update`` span is parented on its update's
+  ``dispatch_train`` context, carries τ (``tau``) in its span args, and
+  shares a trace with the worker-side ``worker.train`` span.
+
 Exit 0 only if every check passes.  This is the CI ``obs-smoke`` job;
 the SLO sentinel gate (``colearn sentinel``) runs as its own CI step.
+Pass phase names (``classic``, ``tree``, ``async``) as argv to run a
+subset.
 """
 
 from __future__ import annotations
@@ -57,10 +72,10 @@ _PROM_LINE = re.compile(
     r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+naif-]+)$")
 
 
-def _config_flags() -> list[str]:
+def _config_flags(n_clients: int = N_WORKERS) -> list[str]:
     return ["--config", "mnist_mlp_fedavg", "--backend", "cpu",
             "--dataset", "mnist_tiny", "--partition", "iid",
-            "--num-clients", str(N_WORKERS), "--rounds", str(ROUNDS),
+            "--num-clients", str(n_clients), "--rounds", str(ROUNDS),
             "--cohort-size", "0", "--local-steps", "2",
             "--batch-size", "16", "--min-cohort-fraction", "0.5",
             "--evict-after", "2", "--seed", "0"]
@@ -183,21 +198,128 @@ def run_tree_phase(check, env: dict) -> None:
             p.wait()
 
 
-def main() -> int:
+def run_async_phase(check, env: dict) -> None:
+    """Buffered-async federation: labeled staleness exposition + the
+    observatory's stitched dispatch -> train -> fold lineage traces."""
+    n_workers = 3
+    workdir = tempfile.mkdtemp(prefix="colearn_obs_async_")
+    trace_dir = os.path.join(workdir, "trace")
+    health_dir = os.path.join(workdir, "health")
+    cfg = _config_flags(n_workers) + ["--health-dir", health_dir]
+    procs: list[subprocess.Popen] = []
+
+    def spawn(args: list[str], **kw) -> subprocess.Popen:
+        p = subprocess.Popen([sys.executable, "-m", _CLI, *args],
+                             env=env, **kw)
+        procs.append(p)
+        return p
+
+    try:
+        broker = spawn(["broker"], stdout=subprocess.PIPE, text=True)
+        addr = json.loads(broker.stdout.readline())
+        host, port = addr["host"], str(addr["port"])
+        for i in range(n_workers):
+            log = open(os.path.join(workdir, f"worker{i}.log"), "ab")
+            spawn(["worker", *cfg, "--client-id", str(i),
+                   "--broker-host", host, "--broker-port", port],
+                  stdout=log, stderr=log)
+        coord = spawn(
+            ["coordinate", *cfg, "--async-buffer", "2", "--async-observe",
+             "--trace-dir", trace_dir, "--metrics-port", "0",
+             "--broker-host", host, "--broker-port", port,
+             "--min-devices", str(n_workers), "--round-timeout", "30",
+             "--enroll-timeout", "90", "--no-evaluator"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+
+        metrics_port = None
+        scraped = False
+        observed_rec = False
+        for line in coord.stderr:
+            try:
+                doc = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if doc.get("event") == "metrics_port":
+                metrics_port = int(doc["port"])
+            if "aggregation" in doc and "arrival_rate_per_s" in doc:
+                observed_rec = True
+            if "aggregation" in doc and not scraped and metrics_port:
+                scraped = True
+                url = f"http://127.0.0.1:{metrics_port}/metrics"
+                text = urllib.request.urlopen(url, timeout=10) \
+                    .read().decode("utf-8")
+                lines = [ln for ln in text.splitlines() if ln]
+                bad = [ln for ln in lines if not _PROM_LINE.match(ln)]
+                check(not bad,
+                      f"async scrape matches the exposition grammar "
+                      f"(bad: {bad[:3]})")
+                stale = [ln for ln in lines
+                         if ln.startswith("colearn_async_staleness{")
+                         and "outcome=" in ln]
+                check(bool(stale),
+                      "scrape carries the labeled staleness histogram "
+                      "(async_staleness{outcome=...})")
+                arrival = [ln for ln in lines if ln.startswith(
+                    "colearn_async_arrival_rate_per_s")]
+                check(bool(arrival),
+                      "scrape carries the arrival-rate gauge")
+        rc = coord.wait(timeout=180)
+        check(rc == 0, f"async coordinator exited 0 (got {rc})")
+        check(observed_rec,
+              "observatory keys (arrival_rate_per_s) in aggregation "
+              "records under --async-observe")
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from colearn_federated_learning_tpu import telemetry
+
+        traces = ([os.path.join(trace_dir, f)
+                   for f in sorted(os.listdir(trace_dir))
+                   if f.endswith("_trace.json")]
+                  if os.path.isdir(trace_dir) else [])
+        check(bool(traces), "async run wrote a Chrome-trace JSON")
+        if traces:
+            spans = telemetry.trace_spans(telemetry.load_trace(traces[0]))
+            folds = [s for s in spans if s.name == "fold_update"]
+            check(bool(folds), "trace carries fold_update lineage spans")
+            check(all("tau" in s.attrs for s in folds),
+                  "every fold_update span carries tau in its args")
+            check(folds and all(s.parent_id for s in folds),
+                  "every fold_update span is parented on its dispatch "
+                  "context")
+            # Full lineage: one trace id holds dispatch -> worker train
+            # -> fold for the same update.
+            stitched = False
+            for f in folds:
+                tier = [s for s in spans if s.trace_id == f.trace_id]
+                names = {s.name for s in tier}
+                if {"dispatch_train", "worker.train",
+                        "fold_update"} <= names:
+                    stitched = True
+                    break
+            check(stitched,
+                  "one trace stitches dispatch_train -> worker.train -> "
+                  "fold_update for an update")
+            aggs = [s for s in spans if s.name == "async.aggregate"]
+            check(bool(aggs) and any(s.attrs.get("link_folds")
+                                     for s in aggs),
+                  "async.aggregate spans cross-link their folds")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+
+
+def run_classic_phase(check, env: dict) -> None:
+    """Flight recorder + exporter + event stream + SIGKILL dump +
+    top/postmortem over one real federation (the original smoke)."""
     workdir = tempfile.mkdtemp(prefix="colearn_obs_")
     flight_dir = os.path.join(workdir, "flight")
     events_path = os.path.join(workdir, "events.jsonl")
-    env = dict(os.environ, PYTHONUNBUFFERED="1", JAX_PLATFORMS="cpu")
     cfg = _config_flags()
     obs = ["--flight-dir", flight_dir, "--flight-heartbeat", "0.5"]
-    failures: list[str] = []
-
-    def check(ok: bool, label: str) -> None:
-        print(f"[obs-smoke] {'ok' if ok else 'FAIL'}: {label}",
-              file=sys.stderr)
-        if not ok:
-            failures.append(label)
-
     procs: list[subprocess.Popen] = []
 
     def spawn(args: list[str], **kw) -> subprocess.Popen:
@@ -339,7 +461,34 @@ def main() -> int:
         for p in procs:
             p.wait()
 
-    run_tree_phase(check, env)
+_PHASES = {
+    "classic": run_classic_phase,
+    "tree": run_tree_phase,
+    "async": run_async_phase,
+}
+
+
+def main(argv=None) -> int:
+    names = list(argv if argv is not None else sys.argv[1:])
+    unknown = [n for n in names if n not in _PHASES]
+    if unknown:
+        print(f"[obs-smoke] unknown phase(s) {unknown}; "
+              f"choose from {sorted(_PHASES)}", file=sys.stderr)
+        return 2
+    if not names:
+        names = ["classic", "tree", "async"]
+    env = dict(os.environ, PYTHONUNBUFFERED="1", JAX_PLATFORMS="cpu")
+    failures: list[str] = []
+
+    def check(ok: bool, label: str) -> None:
+        print(f"[obs-smoke] {'ok' if ok else 'FAIL'}: {label}",
+              file=sys.stderr)
+        if not ok:
+            failures.append(label)
+
+    for name in names:
+        print(f"[obs-smoke] phase: {name}", file=sys.stderr)
+        _PHASES[name](check, env)
 
     if failures:
         print(f"[obs-smoke] {len(failures)} check(s) failed",

@@ -1,0 +1,95 @@
+"""telemetry/arrival.py: the seeded-EWMA arrival-rate estimator that
+drives auto-K buffer sizing and the per-device straggler attribution
+feeds.  Everything here runs on a caller-supplied clock — no time.time()
+— so the tests pin exact rates, not sleeps."""
+
+import pytest
+
+from colearn_federated_learning_tpu.telemetry.arrival import (
+    ArrivalEstimator,
+)
+from colearn_federated_learning_tpu.telemetry.registry import (
+    MetricsRegistry,
+)
+
+
+def test_alpha_must_be_in_unit_interval():
+    for bad in (0.0, -0.1, 1.5):
+        with pytest.raises(ValueError, match="alpha"):
+            ArrivalEstimator(alpha=bad)
+    ArrivalEstimator(alpha=1.0)        # boundary is legal: no smoothing
+
+
+def test_first_gap_seeds_the_ewma_directly():
+    est = ArrivalEstimator(alpha=0.3)
+    assert est.rate() == 0.0           # no arrivals yet
+    est.observe(now=10.0)
+    assert est.rate() == 0.0           # one arrival: no gap yet
+    est.observe(now=12.0)
+    # The first 2-unit gap SEEDS the EWMA (rate = 1/2), it is not
+    # blended against a zero init — the whole point of the seeding.
+    assert est.rate() == pytest.approx(0.5)
+    assert est.count == 2
+
+
+def test_later_gaps_blend_with_alpha():
+    est = ArrivalEstimator(alpha=0.5)
+    for t in (0.0, 2.0, 6.0):          # gaps 2 then 4
+        est.observe(now=t)
+    # gap_ewma = 0.5*4 + 0.5*2 = 3 -> rate 1/3
+    assert est.rate() == pytest.approx(1.0 / 3.0)
+
+
+def test_per_device_streams_are_independent_of_the_fleet():
+    est = ArrivalEstimator()
+    # Two devices interleaved: fleet sees gap 1, each device gap 2.
+    for t, dev in ((0.0, "a"), (1.0, "b"), (2.0, "a"), (3.0, "b")):
+        est.observe(dev, now=t)
+    assert est.rate() == pytest.approx(1.0)
+    assert est.device_rate("a") == pytest.approx(0.5)
+    assert est.device_rates() == {
+        "a": pytest.approx(0.5), "b": pytest.approx(0.5)}
+    assert est.device_rate("missing") == 0.0
+
+
+def test_recommend_buffer_is_rate_times_target_clamped():
+    est = ArrivalEstimator()
+    est.observe(now=0.0)
+    est.observe(now=0.5)               # rate 2/unit
+    assert est.recommend_buffer(10.0) == 20
+    assert est.recommend_buffer(10.0, hi=8) == 8
+    assert est.recommend_buffer(0.1, lo=4) == 4
+
+
+def test_recommend_buffer_cold_fallback_holds_current():
+    est = ArrivalEstimator()
+    # Cold estimator: keep the caller's K (never yank the buffer around
+    # before there is a measurement), or lo if the caller has none.
+    assert est.recommend_buffer(10.0, current=6) == 6
+    assert est.recommend_buffer(10.0, lo=2) == 2
+    est.observe(now=0.0)               # still cold: one arrival, no gap
+    assert est.recommend_buffer(10.0, current=6) == 6
+
+
+def test_export_gauges_sets_fleet_and_top_device_children():
+    est = ArrivalEstimator()
+    for t, dev in ((0.0, "fast"), (0.0, "slow"),
+                   (1.0, "fast"), (10.0, "slow")):
+        est.observe(dev, now=t)
+    reg = MetricsRegistry()
+    est.export_gauges(reg, "async.arrival_rate_per_s", top=1)
+    snap = reg.snapshot()
+    assert snap["async.arrival_rate_per_s"] > 0.0       # fleet gauge
+    # top=1 keeps only the fastest device's labeled child.
+    assert "async.arrival_rate_per_s{device=fast}" in snap
+    assert "async.arrival_rate_per_s{device=slow}" not in snap
+
+
+def test_snapshot_is_json_safe_and_complete():
+    est = ArrivalEstimator()
+    est.observe("d0", now=0.0)
+    est.observe("d0", now=4.0)
+    snap = est.snapshot()
+    assert snap["count"] == 2
+    assert snap["rate"] == pytest.approx(0.25)
+    assert snap["devices"]["d0"] == pytest.approx(0.25)

@@ -572,16 +572,20 @@ def test_async_update_pruning_policy():
     slow, fast = DeviceHealth("s"), DeviceHealth("f")
     slow.counts["deadline_miss"] = 4          # score 12
     slow.lat_ewma, fast.lat_ewma = 9.0, 1.0   # median 5 -> +0.8
+    attributed = []
     ns2 = types.SimpleNamespace(
         _pruned={}, _stale_streak={},
         prune_after=0, prune_score=12.5, probation=4, buffer_size=1,
         _health_lock=threading.Lock(),
         health=types.SimpleNamespace(
-            devices=lambda: {"s": slow, "f": fast}),
+            devices=lambda: {"s": slow, "f": fast},
+            record=lambda d, **kw: attributed.append((d, kw))),
         trainers=mk(["s", "f"]), _state_lock=threading.Lock())
     upd(ns2, 0)
     assert ns2._pruned == {"s": 4}
     assert pruned_score.value - p0c == 1
+    # The prune is attributed to the device in the health ledger.
+    assert attributed == [("s", {"prune": 1})]
 
 
 def test_async_pruning_pauses_pump_and_readmits(tmp_path):
@@ -651,7 +655,10 @@ def test_async_default_records_have_no_feature_keys():
                 coord.enroll(min_devices=3, timeout=20.0)
                 rec = coord.run_aggregation()
             for key in ("pruned", "evicted", "skipped_quorum",
-                        "health_devices", "health_worst_device"):
+                        "health_devices", "health_worst_device",
+                        "mass_folded", "mass_discarded",
+                        "arrival_rate_per_s", "staleness_p50",
+                        "staleness_p90", "staleness_p99"):
                 assert key not in rec, key
         finally:
             for w in workers:

@@ -460,3 +460,48 @@ def test_fit_async_validates_inputs():
     from_learner = fleetsim.FleetSim.from_learner(learner, chunk_size=4)
     with pytest.raises(NotImplementedError, match="traffic"):
         from_learner.fit_async(2, buffer_size=2)
+    # The version-grouped fold pads each group to one chunk dispatch, so
+    # a buffer wider than the chunk can never fold in one program.
+    narrow = make_fleet(num_devices=32, cohort=8, chunk=4)
+    with pytest.raises(ValueError, match="buffer"):
+        narrow.fit_async(2, buffer_size=8)
+    with pytest.raises(ValueError, match="auto"):
+        fs.fit_async(2, buffer_size="adaptive")
+
+
+def test_fit_async_observe_stamps_observatory_keys():
+    fs = make_fleet(num_devices=32, cohort=8, chunk=8)
+    hist = fs.fit_async(6, buffer_size=8, max_staleness=8, observe=True)
+    for rec in hist:
+        # Staleness tail + contribution mass + EWMA arrival rate ride
+        # along only when the observatory is armed.
+        assert rec["mass_folded"] > 0.0
+        assert rec["mass_discarded"] >= 0.0
+        assert rec["arrival_rate_ewma_per_min"] >= 0.0
+        assert (rec["staleness_p50"] <= rec["staleness_p90"]
+                <= rec["staleness_p99"])
+    # Compile-once must survive the extra bookkeeping.
+    assert fs.compile_counts == {"chunk": 1, "finish": 1, "fold": 1}
+
+
+def test_fit_async_auto_buffer_sizes_from_arrival_rate():
+    reg = telemetry.get_registry()
+    fs = make_fleet(num_devices=32, cohort=8, chunk=8)
+    hist = fs.fit_async(10, buffer_size="auto", max_staleness=8,
+                        auto_interval_min=2.0)
+    assert len(hist) == 10
+    for rec in hist:
+        # Auto-K stays inside the only legal band: at least 1, never
+        # wider than the compiled chunk.
+        assert 1 <= rec["buffer_size"] <= 8
+        # auto implies observe: the records carry the measurements that
+        # drove the sizing.
+        assert "arrival_rate_ewma_per_min" in rec
+    # The controller actually resized at least once off the warm-start
+    # K=8 (2-minute target x observed rate lands away from 8).
+    assert len({rec["buffer_size"] for rec in hist}) > 1
+    assert reg.gauge("fleetsim.async_buffer_size").value == \
+        hist[-1]["buffer_size"]
+    # One compile per shape still holds across resizes: the fold pads
+    # every group to chunk_size regardless of K.
+    assert fs.compile_counts == {"chunk": 1, "finish": 1, "fold": 1}

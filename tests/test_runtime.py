@@ -276,3 +276,82 @@ def test_render_top_aggregator_tier_section():
     # flat runs keep the old layout: no tier section at all
     assert "aggregator tier" not in runtime.render_top(
         {"fed.rounds_total": 4})
+
+
+# ------------------------------------------- staleness observatory ------
+def test_staleness_histogram_and_arrival_gauge_exposition():
+    # The async coordinator's observatory instruments, as scraped: the
+    # outcome-labeled staleness histogram must expose as ONE summary
+    # family with per-outcome children plus the unlabeled roll-up, and
+    # the arrival estimator's gauges must land fleet + per-device.
+    from colearn_federated_learning_tpu.telemetry.arrival import (
+        ArrivalEstimator,
+    )
+    reg = MetricsRegistry()
+    for tau in (0, 1, 3):
+        reg.histogram("async.staleness",
+                      labels={"outcome": "folded"}).observe(tau)
+    reg.histogram("async.staleness",
+                  labels={"outcome": "discarded"}).observe(9)
+    est = ArrivalEstimator()
+    est.observe("d0", now=0.0)
+    est.observe("d0", now=2.0)
+    est.export_gauges(reg, "async.arrival_rate_per_s")
+
+    text = runtime.prometheus_text(reg.typed_snapshot())
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    assert text.count("# TYPE colearn_async_staleness summary") == 1
+    assert ('colearn_async_staleness'
+            '{quantile="0.5",outcome="folded"} 1') in text
+    assert 'colearn_async_staleness_count{outcome="folded"} 3' in text
+    assert 'colearn_async_staleness_sum{outcome="discarded"} 9' in text
+    assert "colearn_async_staleness_count 4" in text    # the roll-up
+    assert "# TYPE colearn_async_arrival_rate_per_s gauge" in text
+    assert "colearn_async_arrival_rate_per_s 0.5" in text
+    assert 'colearn_async_arrival_rate_per_s{device="d0"} 0.5' in text
+
+
+def test_render_top_async_plane_section():
+    snap = {"fed.rounds_total": 4,
+            "async.aggregations_total": 12,
+            "async.buffer_target": 8,
+            "async.arrival_rate_per_s": 2.5,
+            "async.updates_discarded_stale": 3,
+            "async.staleness": {"count": 15, "sum": 20.0,
+                                "p50": 1.0, "p90": 4.0, "p99": 6.0},
+            "async.contribution_mass{outcome=folded}": 10.5,
+            "async.contribution_mass{outcome=discarded}": 0.75,
+            "async.pumps{state=wait}": 5,
+            "async.pumps{state=train}": 3}
+    body = runtime.render_top(snap)
+    assert "async plane" in body
+    assert "aggregations" in body and "12" in body
+    assert "buffer K" in body
+    assert "arrival rate" in body and "2.500/s" in body
+    assert "stale discards" in body
+    stale = next(ln for ln in body.splitlines() if "staleness" in ln)
+    assert "p50 1.0" in stale and "p90 4.0" in stale and "p99 6.0" in stale
+    mass = next(ln for ln in body.splitlines() if "mass folded" in ln)
+    assert "10.50" in mass and "0.75" in mass
+    pumps = next(ln for ln in body.splitlines() if "pumps" in ln)
+    assert "wait 5" in pumps and "train 3" in pumps
+    # flat sync snapshots keep the classic layout: no async section
+    assert "async plane" not in runtime.render_top(
+        {"fed.rounds_total": 4})
+
+
+def test_render_top_async_plane_fleetsim_aliases():
+    # fleetsim's virtual-clock async plane feeds the same section
+    # through its own metric names (per-minute rate units).
+    snap = {"fleetsim.async_aggregations_total": 6,
+            "fleetsim.async_buffer_size": 4,
+            "fleetsim.async_arrival_rate_per_min": 1.2,
+            "fleetsim.async_updates_discarded_total": 2,
+            "fleetsim.async_staleness": {"count": 8, "sum": 9.0,
+                                         "p50": 1.0, "p90": 2.0,
+                                         "p99": 3.0}}
+    body = runtime.render_top(snap)
+    assert "async plane" in body
+    assert "1.200/min" in body
+    assert "p99 3.0" in body
