@@ -90,6 +90,9 @@ COUNTERS = (
     "fed.offline_updates_rejected_total",  # labeled {reason=torn|stale|...}
     "fed.offline_residual_resets_total",   # labeled {reason=stale|...}
     "fed.hier_groups_dropped_total",       # labeled per group: {group=g1}
+    # LoRA adapter plane (fed/lora.py, comm/coordinator.py): server-side
+    # B·A·(α/r) merges of aggregated factors into the global model
+    "fed.lora_merges_total",
     # buffered-async plane (comm/async_coordinator.py)
     "async.dispatch_failures",
     "async.aggregations_total",
@@ -140,6 +143,10 @@ GAUGES = (
     # adaptive topk (comm/worker.py _adapt_topk): the per-round density
     # the controller actually used, inside [topk_min, topk_max]
     "fed.topk_fraction_effective",
+    # LoRA adapter plane (comm/coordinator.py): configured rank and the
+    # trainable factor-parameter count it induces on the global model
+    "fed.lora_rank",
+    "fed.lora_factor_params",
     # live HBM sampling (telemetry/runtime.py; empty on CPU backends)
     "runtime.hbm_bytes_in_use",
     "runtime.hbm_bytes_limit",

@@ -101,6 +101,16 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "(needs --compress topk + feedback)")
     p.add_argument("--topk-min-fraction", type=float, default=None)
     p.add_argument("--topk-max-fraction", type=float, default=None)
+    p.add_argument("--lora-rank", type=int, default=None,
+                   help="rank-r LoRA adapter federation (fed/lora.py): "
+                        "clients train and ship rank-r factors instead "
+                        "of dense deltas (0 = off)")
+    p.add_argument("--lora-alpha", type=float, default=None,
+                   help="LoRA scaling numerator: merged delta is "
+                        "B·A·(alpha/rank)")
+    p.add_argument("--lora-merge-every", type=int, default=None,
+                   help="server merges aggregated factors into the "
+                        "global model every N aggregations")
     p.add_argument("--num-aggregators", type=int, default=None,
                    help="aggregator-tree fan-in: N `colearn aggregator` "
                         "processes each fold one cohort slice and ship "
@@ -248,6 +258,7 @@ _FED_KEYS = {"rounds", "cohort_size", "local_epochs", "local_steps",
              "straggler_prob", "compress", "compress_down", "aggregator",
              "compress_feedback", "topk_fraction", "topk_adaptive",
              "topk_min_fraction", "topk_max_fraction",
+             "lora_rank", "lora_alpha", "lora_merge_every",
              "trim_fraction", "edge_groups", "edge_sync_period",
              "min_cohort_fraction"}
 _DATA_KEYS = {"num_clients", "dataset", "partition", "dirichlet_alpha"}
@@ -851,7 +862,8 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
         fed=FedConfig(strategy=args.strategy, local_steps=args.local_steps,
                       batch_size=args.batch_size, lr=args.lr,
                       compress=args.compress,
-                      compress_down=args.compress_down or "none"),
+                      compress_down=args.compress_down or "none",
+                      lora_rank=args.lora_rank, lora_alpha=args.lora_alpha),
         run=RunConfig(name="fleetsim", seed=args.seed))
     plan = None
     if args.fault_plan:
@@ -1366,6 +1378,11 @@ def main(argv: list[str] | None = None) -> int:
     p_fleet.add_argument("--compress", default="none",
                          choices=["none", "int8", "topk", "topk8"],
                          help="uplink scheme for the byte estimates")
+    p_fleet.add_argument("--lora-rank", type=int, default=0,
+                         help="rank-r adapter federation: price the "
+                              "factor-frame uplink (bytes_up_saved_est; "
+                              "training dynamics stay dense in the sim)")
+    p_fleet.add_argument("--lora-alpha", type=float, default=16.0)
     p_fleet.add_argument("--compress-down", default="none",
                          choices=["none", "int8", "topk"])
     p_fleet.add_argument("--fault-plan", default=None,

@@ -238,8 +238,12 @@ class AggregatorServer:
         body = memoryview(pytree_to_bytes(tree, meta_in or None))
         # The decoded params tree IS the shapes template (StreamingFolder
         # only reads leaf shapes), so the aggregator needs no model code.
+        # Under lora the broadcast is a {"base", "factors"} composite
+        # (meta carries the ``lora`` marker) and the replies are FACTOR
+        # trees — the factors half is the fold template.
         order = [str(int(d[0])) for d in devices]
-        folder = StreamingFolder(tree, order=order)
+        shapes = tree["factors"] if meta_in.get("lora") else tree
+        folder = StreamingFolder(shapes, order=order)
         stale: list[str] = []
         failed: list[str] = []
         worker_spans: list = []

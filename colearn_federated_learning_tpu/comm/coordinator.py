@@ -25,6 +25,7 @@ import time
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from colearn_federated_learning_tpu.comm.broker import BrokerClient
@@ -142,6 +143,28 @@ class FederatedCoordinator:
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy,
                                          device_type=device_type)
         params = setup_lib.init_global_params(config)
+        # LoRA adapter plane (fed/lora.py): with fed.lora_rank > 0 the
+        # server keeps a frozen base plus a small factor tree; rounds
+        # broadcast a {"base", "factors"} composite, fold FACTOR deltas,
+        # and every ``lora_merge_every`` aggregations merge B·A·(α/r)
+        # into the (possibly tp-sharded) base shard-wise.  Factors are
+        # initialized from the HOST params (shape-only) before sharding.
+        self._lora = config.fed.lora_rank > 0
+        self._factors = None
+        self._lora_agg_count = 0
+        self._merge_fn = None
+        if self._lora:
+            from colearn_federated_learning_tpu.fed import lora as lora_lib
+
+            self._factors = setup_lib.init_lora_factors(config, params)
+            _alpha = float(config.fed.lora_alpha)
+            _rank = int(config.fed.lora_rank)
+            self._merge_fn = jax.jit(
+                lambda p, f: lora_lib.merge_adapters(p, f, _alpha, _rank))
+            reg = telemetry.get_registry()
+            reg.gauge("fed.lora_rank").set(_rank)
+            reg.gauge("fed.lora_factor_params").set(
+                lora_lib.count_factor_params(self._factors))
         # PR 9 sharded server: with run.tp_size > 1 the global model,
         # optimizer state, and aggregation live SHARDED over a local 1-D
         # (model,) mesh — the streaming fold stages per-shard slices, the
@@ -171,6 +194,13 @@ class FederatedCoordinator:
                     np.shape(a)),
                 params,
             )
+        # Fold/mask shape template: the FACTOR tree under lora (the
+        # uplink ships factors), the param tree otherwise.  Factor folds
+        # never placement-slice — factors stay replicated server-side;
+        # only the merged base is tp-sharded.
+        self._fold_shapes = (jax.tree.map(np.asarray, self._factors)
+                             if self._lora else self._shapes_np)
+        self._fold_placement = None if self._lora else self._placement
         self.server_state = strategies.init_server_state(params, config.fed)
         if self._placement is not None:
             telemetry.get_registry().gauge(
@@ -201,7 +231,7 @@ class FederatedCoordinator:
         # per-update bytes a compressed uplink saves vs the dense frame —
         # the same invariant the wire bench measures against.
         self._uplink_saved_per_update = 0
-        if config.fed.compress != "none":
+        if config.fed.compress != "none" or self._lora:
             from colearn_federated_learning_tpu.fed import compression
             from colearn_federated_learning_tpu.utils.serialization import (
                 wire_frame_length,
@@ -211,11 +241,22 @@ class FederatedCoordinator:
                 lambda a: np.zeros(np.shape(a), np.float32), self._shapes_np)
             dense_len = wire_frame_length(
                 zeros, {"round": 0, "op": "train", "compress": "none"})
-            wire_up, meta_up = compression.compress_delta(
-                zeros, config.fed.compress,
-                topk_fraction=config.fed.topk_fraction)
-            comp_len = wire_frame_length(
-                wire_up, {"round": 0, "op": "train", **meta_up})
+            # Under lora the update ON THE WIRE is the factor tree — the
+            # savings vs a dense full-model uplink are what the record
+            # (and the wire bench) price; an uplink codec composes on
+            # top of the factors.
+            sample = (jax.tree.map(
+                lambda a: np.zeros(np.shape(a), np.float32),
+                self._fold_shapes) if self._lora else zeros)
+            if config.fed.compress != "none":
+                wire_up, meta_up = compression.compress_delta(
+                    sample, config.fed.compress,
+                    topk_fraction=config.fed.topk_fraction)
+                comp_len = wire_frame_length(
+                    wire_up, {"round": 0, "op": "train", **meta_up})
+            else:
+                comp_len = wire_frame_length(
+                    sample, {"round": 0, "op": "train", "compress": "none"})
             self._uplink_saved_per_update = max(0, int(dense_len - comp_len))
         self._ckpt = None
         # Round WAL rides next to the orbax checkpoint: one fsynced JSON
@@ -733,8 +774,17 @@ class FederatedCoordinator:
             # full params for workers whose cache missed the delta's base.
             # The encoder reads (possibly sharded) params via PER-SHARD
             # host reads — no full-tree gather on this path (CL012).
-            body, resync_body, saved = self._downlink.encode_round(
-                r, self.server_state.params)
+            if self._lora:
+                # Composite broadcast (base + this cycle's factors), one
+                # encode shared by every send.  The DownlinkEncoder's
+                # delta-cache protocol is bypassed — compress_down is
+                # rejected under lora (validate_robustness) — and the
+                # ``lora`` meta marker tells the aggregator tier to fold
+                # FACTOR-shaped replies.
+                body, resync_body, saved = self._encode_lora_round(r)
+            else:
+                body, resync_body, saved = self._downlink.encode_round(
+                    r, self.server_state.params)
         cohort_ids = sorted(int(d.device_id) for d in cohort)
         reg = telemetry.get_registry()
 
@@ -754,9 +804,9 @@ class FederatedCoordinator:
             # regroups the float sum exactly like the flat fold with
             # ``slices=`` (see aggregator.py module docstring on parity).
             folder = StreamingFolder(
-                self._shapes_np,
+                self._fold_shapes,
                 order=[f"slice:{i}" for i in range(len(slices))],
-                placement=self._placement)
+                placement=self._fold_placement)
             with self.tracer.span("broadcast_collect",
                                   cohort=len(cohort)) as collect_sp:
                 train_timeout = max(1.0, self.round_timeout
@@ -806,9 +856,9 @@ class FederatedCoordinator:
             # streaming changes round records not at all — see
             # StreamingFolder docstring.
             folder = StreamingFolder(
-                self._shapes_np,
+                self._fold_shapes,
                 order=[str(int(d.device_id)) for d in cohort],
-                placement=self._placement)
+                placement=self._fold_placement)
 
             def fold(dev: DeviceInfo, res) -> None:
                 meta, delta = res
@@ -911,10 +961,14 @@ class FederatedCoordinator:
                 # Workers omit per-client losses under secure aggregation
                 # (the per-client statistic is what the masks hide).
                 mean_loss = float("nan")
+            lora_merged = False
             if mean_delta is not None:
-                self.server_state = strategies.server_update(
-                    self.server_state, mean_delta, self.config.fed
-                )
+                if self._lora:
+                    lora_merged = self._apply_lora_update(mean_delta)
+                else:
+                    self.server_state = strategies.server_update(
+                        self.server_state, mean_delta, self.config.fed
+                    )
         evicted = self._note_round_outcome(cohort_full, dropped)
         rec = {
             "round": r,
@@ -936,12 +990,15 @@ class FederatedCoordinator:
             # Key only present when the quorum feature is on, so default
             # round records stay byte-identical.
             rec["skipped_quorum"] = skipped_quorum
-        if self.config.fed.compress != "none":
+        if self.config.fed.compress != "none" or self._lora:
             # Uplink fast-path accounting; keys only present when an
-            # uplink codec is on (same byte-identical-record convention).
+            # uplink codec (or the adapter plane) is on — default round
+            # records stay byte-identical.
             rec["bytes_saved_uplink"] = (self._uplink_saved_per_update
                                          * folded)
             rec["uplink_densify_avoided"] = folder.densify_avoided
+        if self._lora:
+            rec["lora_merged"] = lora_merged
         if tree_mode:
             rec["aggregators"] = self.num_aggregators
             # Middle-tier wall time (slowest slice fold — slices run
@@ -1445,6 +1502,82 @@ class FederatedCoordinator:
             folder.apply_correction(correction)
         return True
 
+    # ---- LoRA adapter plane (fed/lora.py) --------------------------------
+    def _encode_lora_round(self, r: int):
+        """Serialize-once lora broadcast: ONE frame holding the frozen
+        base (read per-shard off the sharded server — no gather) plus the
+        current factor tree, stamped with the ``lora`` meta marker the
+        aggregator tier keys its factor-shaped fold template off.  Same
+        (body, resync_body, saved) contract as DownlinkEncoder: resync
+        never triggers (workers hold no delta cache under lora)."""
+        from colearn_federated_learning_tpu.comm.downlink import host_params
+        from colearn_federated_learning_tpu.utils.serialization import (
+            pytree_to_bytes,
+        )
+
+        composite = {
+            "base": host_params(self.server_state.params),
+            "factors": jax.tree.map(np.asarray, self._factors),
+        }
+        body = pytree_to_bytes(
+            composite, {"round": r, "lora": self.config.fed.lora_rank})
+        telemetry.get_registry().counter("comm.broadcast_encode_total").inc()
+        return memoryview(body), None, 0
+
+    def _apply_lora_update(self, mean_delta) -> bool:
+        """Fold the round's mean FACTOR delta into the server factors
+        (manual FedAvg/FedProx step — adaptive server optimizers are
+        rejected for lora by validate_robustness, their moment state is
+        params-shaped) and, every ``lora_merge_every`` aggregations,
+        merge B·A·(α/r) into the (possibly tp-sharded) base shard-wise.
+        Returns True when this round merged."""
+        lr = self.config.fed.server_lr
+        self._factors = jax.tree.map(
+            lambda f, d: f + lr * jnp.asarray(np.asarray(d), f.dtype),
+            self._factors, mean_delta)
+        self.server_state = self.server_state._replace(
+            round_idx=self.server_state.round_idx + 1)
+        self._lora_agg_count += 1
+        if self._lora_agg_count < self.config.fed.lora_merge_every:
+            return False
+        self._merge_lora()
+        return True
+
+    def _merge_lora(self) -> None:
+        """Jitted shard-wise merge: every op is elementwise in the base
+        leaf plus a small replicated r-contraction, so XLA keeps each
+        leaf's output in its input sharding — the bytes a replicated
+        merge would have gathered are counted in
+        ``comm.gather_bytes_avoided_total``.  B resets to zero (the
+        merged delta now lives in the base); A is kept, so the factor
+        tree's shapes — and the workers' one compile signature — never
+        change."""
+        from colearn_federated_learning_tpu.fed import lora as lora_lib
+        from colearn_federated_learning_tpu.parallel import (
+            partition as partition_lib,
+        )
+
+        reg = telemetry.get_registry()
+        avoided = partition_lib.tree_gather_avoided(self.server_state.params)
+        merged = self._merge_fn(self.server_state.params, self._factors)
+        self.server_state = self.server_state._replace(params=merged)
+        self._factors = lora_lib.reset_factors(self._factors)
+        self._lora_agg_count = 0
+        reg.counter("fed.lora_merges_total").inc()
+        if avoided:
+            reg.counter("comm.gather_bytes_avoided_total").inc(avoided)
+
+    def _eval_params(self):
+        """The model evaluation scores: under lora the UNMERGED factor
+        cycle still carries signal, so a temp merge folds it in without
+        touching the server base (checkpoints carry only the base — at
+        most ``lora_merge_every`` rounds of factor progress ride outside
+        the checkpoint, a documented limitation)."""
+        params = self.server_state.params
+        if self._lora:
+            params = self._merge_fn(params, self._factors)
+        return params
+
     def evaluate_per_client(self) -> dict:
         """Score the CURRENT global model on every trainer's own shard
         (the engine's ``evaluate_per_client`` over the wire): fan-out
@@ -1459,7 +1592,7 @@ class FederatedCoordinator:
             pytree_to_bytes,
         )
 
-        params_np = jax.tree.map(np.asarray, self.server_state.params)
+        params_np = jax.tree.map(np.asarray, self._eval_params())
         # Serialize-once here too: one shared frame for the whole fan-out.
         body = memoryview(pytree_to_bytes(params_np))
         telemetry.get_registry().counter("comm.broadcast_encode_total").inc()
@@ -1503,7 +1636,7 @@ class FederatedCoordinator:
         """Score the global model on the evaluator device (SURVEY.md §3d)."""
         if self.evaluator is None:
             raise RuntimeError("no evaluator was assigned")
-        params_np = jax.tree.map(np.asarray, self.server_state.params)
+        params_np = jax.tree.map(np.asarray, self._eval_params())
         with self.tracer.span("evaluate"):
             header, _ = self._clients[self.evaluator.device_id].request(
                 protocol.attach_trace({"op": "eval"},

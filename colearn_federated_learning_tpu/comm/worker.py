@@ -169,10 +169,21 @@ class DeviceWorker:
         self.num_examples = int(shard.counts[0])
 
         model = model_registry.build_model(setup_lib.local_model_config(c.model))
-        local_update, self._num_steps = setup_lib.local_trainer_for_config(
-            c, model.apply, shard.capacity
-        )
-        self._update_fn = jax.jit(local_update)
+        self._lora = c.fed.lora_rank > 0
+        if self._lora:
+            # Factor-only trainer (fed/local.py make_lora_local_update):
+            # broadcasts arrive as a {"base", "factors"} composite, the
+            # base stays frozen, and the reply delta is the O(r·d)
+            # factor tree.  One jitted signature, same as the dense path.
+            lora_update, self._num_steps = setup_lib.lora_trainer_for_config(
+                c, model.apply, shard.capacity
+            )
+            self._update_fn = jax.jit(lora_update)
+        else:
+            local_update, self._num_steps = setup_lib.local_trainer_for_config(
+                c, model.apply, shard.capacity
+            )
+            self._update_fn = jax.jit(local_update)
         self._model = model
         self._eval_fn = None          # built on first eval request
         self._key = prng.experiment_key(c.run.seed)
@@ -627,10 +638,17 @@ class DeviceWorker:
                          "error": f"client {self.client_id} has no cached "
                                   f"base for round {round_idx} delta"},
                         None)
-            params = jax.tree.map(jnp.asarray, full)
+            if self._lora:
+                # Composite broadcast: frozen base + this cycle's factors
+                # (compress_down is rejected under lora, so the tree is
+                # always the plain decoded frame).
+                args = (jax.tree.map(jnp.asarray, full["base"]),
+                        jax.tree.map(jnp.asarray, full["factors"]))
+            else:
+                args = (jax.tree.map(jnp.asarray, full),)
         with self.tracer.span("local_train", steps=self._num_steps):
             result = self._update_fn(
-                params, self._x, self._y, self._count,
+                *args, self._x, self._y, self._count,
                 prng.client_round_key(self._key, self.client_id, round_idx),
                 jnp.asarray(self._num_steps, jnp.int32),
                 strategies.lr_scale_for_round(self.config.fed, round_idx),
@@ -788,8 +806,18 @@ class DeviceWorker:
                 jax.tree.map(np.asarray, mask))
 
     def _template_params(self):
+        """Shape template for the wire payload this worker ships — the
+        factor tree under lora (masks/recovery frames must match what was
+        masked), the full param tree otherwise."""
         if not hasattr(self, "_param_template"):
-            self._param_template = setup_lib.init_global_params(self.config)
+            params = setup_lib.init_global_params(self.config)
+            if self._lora:
+                from colearn_federated_learning_tpu.fed import lora
+
+                params = lora.init_factors(
+                    params, self.config.fed.lora_rank,
+                    model_name=self.config.model.name)
+            self._param_template = params
         return self._param_template
 
     def _self_eval(self, global_params: Any) -> tuple[dict, Any]:

@@ -32,7 +32,7 @@ from colearn_federated_learning_tpu.data.sharding import (
     pad_clients_to_multiple,
 )
 from colearn_federated_learning_tpu.fed import programs
-from colearn_federated_learning_tpu.fed.programs import _rank_cohort
+from colearn_federated_learning_tpu.fed.programs import rank_cohort
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu.fed.evaluation import (
@@ -528,7 +528,7 @@ class FederatedLearner:
             if self.cohort_size < self.num_clients:
                 skey = prng.sampling_key(self.base_key, r)
                 sel = np.asarray(
-                    _rank_cohort(skey, counts, self.cohort_size)
+                    rank_cohort(skey, counts, self.cohort_size)
                 ).astype(np.int32)
             else:
                 sel = np.arange(self.num_clients, dtype=np.int32)
@@ -541,7 +541,7 @@ class FederatedLearner:
             if cpd < L:
                 dkey = jax.random.fold_in(skey, d)
                 s = np.asarray(
-                    _rank_cohort(dkey, counts[d * L:(d + 1) * L], cpd)
+                    rank_cohort(dkey, counts[d * L:(d + 1) * L], cpd)
                 ).astype(np.int32)
             else:
                 s = np.arange(L, dtype=np.int32)

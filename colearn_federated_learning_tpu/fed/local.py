@@ -227,3 +227,98 @@ def make_local_update(
         )
 
     return scaffold_update
+
+
+def make_lora_local_update(
+    apply_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    num_steps: int,
+    batch_size: int,
+    rank: int,
+    alpha: float,
+    prox_mu: float = 0.0,
+    min_steps_fraction: float = 0.25,
+    aux_loss_weight: float = 0.0,
+) -> Callable:
+    """Build ``lora_update(base_params, factors, x, y, count, key,
+    step_budget, lr_scale=None)`` — the factor-only twin of
+    :func:`make_local_update`.
+
+    The base params are a FROZEN constant of the loss: autodiff runs
+    w.r.t. the factor tree only, the forward pass applies the adapters
+    through :func:`fed.lora.apply_adapters`, and the returned
+    ``LocalResult.delta`` is a FACTOR delta (trained - received factors)
+    — the O(r·d) tree the uplink ships.  Structure mirrors the dense
+    trainer exactly (same scan, same per-step fold_in sampling, same
+    ``step_budget`` masking, same lr_scale semantics), so shapes stay
+    static and the jitted program holds ONE compile signature across
+    rounds (pinned via telemetry CompileTracker in tests).
+
+    ``prox_mu`` applies FedProx's proximal pull on the FACTORS
+    (``mu/2 * ||f - f_global||^2``) — the natural restriction when the
+    factors are the only trainable coordinates."""
+    from colearn_federated_learning_tpu.fed import lora
+
+    min_steps = max(1, int(num_steps * min_steps_fraction))
+    from colearn_federated_learning_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    reg.counter("local.trainers_built").inc()
+    reg.gauge("local.steps_per_round").set(num_steps)
+
+    def loss_fn(factors, base_params, global_factors, xb, yb):
+        params = lora.apply_adapters(base_params, factors, alpha, rank)
+        if aux_loss_weight > 0.0:
+            logits, updates = apply_fn(
+                {"params": params}, xb, train=True, mutable=["intermediates"]
+            )
+            aux = _sown_aux_mean(updates.get("intermediates", {}))
+            extra = aux_loss_weight * aux if aux is not None else 0.0
+        else:
+            logits = apply_fn({"params": params}, xb, train=True)
+            extra = 0.0
+        loss = losses.softmax_cross_entropy(logits, yb) + extra
+        if prox_mu > 0.0:
+            loss = loss + 0.5 * prox_mu * pytrees.tree_sq_norm(
+                pytrees.tree_sub(factors, global_factors)
+            )
+        return loss
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def lora_update(base_params, factors, x, y, count, key, step_budget,
+                    lr_scale=None):
+        opt_state = optimizer.init(factors)
+        safe_count = jnp.maximum(count, 1)
+
+        def step(carry, t):
+            f, opt_state = carry
+            k = jax.random.fold_in(key, t)
+            idx = jax.random.randint(k, (batch_size,), 0, safe_count)
+            xb = jnp.take(x, idx, axis=0)
+            yb = jnp.take(y, idx, axis=0)
+            loss, grads = grad_fn(f, base_params, factors_in, xb, yb)
+            updates, new_opt_state = optimizer.update(grads, opt_state, f)
+            if lr_scale is not None:
+                updates = pytrees.tree_scale(updates, lr_scale)
+            new_f = optax.apply_updates(f, updates)
+            active = t < step_budget
+            f = _tree_where(active, new_f, f)
+            opt_state = _tree_where(active, new_opt_state, opt_state)
+            return (f, opt_state), loss * active
+
+        factors_in = factors
+        (f, _), step_losses = jax.lax.scan(
+            step, (factors, opt_state), jnp.arange(num_steps)
+        )
+        executed = jnp.minimum(step_budget, num_steps).astype(jnp.float32)
+        mean_loss = jnp.sum(step_losses) / jnp.maximum(executed, 1.0)
+        return LocalResult(
+            delta=pytrees.tree_sub(f, factors_in),
+            num_examples=count.astype(jnp.int32),
+            completed=step_budget >= min_steps,
+            mean_loss=mean_loss,
+            steps_run=executed,
+        )
+
+    return lora_update

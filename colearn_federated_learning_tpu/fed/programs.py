@@ -39,16 +39,21 @@ from colearn_federated_learning_tpu.privacy import secure_agg as sa_lib
 from colearn_federated_learning_tpu.utils import prng, pytrees
 
 
-def _rank_cohort(skey, counts, k):
+def rank_cohort(skey, counts, k):
     """Uniform sample of ``k`` clients WITHOUT replacement among real
     clients: ghosts (count 0) are pushed to the end of the ranking and only
     picked if the cohort exceeds real clients.  Pure jnp — the SAME function
     runs traced inside the round program (fedavg paths) and eagerly on host
     (the scaffold path, which must know the cohort before dispatch to gather
-    its variate rows); any edit applies to both."""
+    its variate rows; fleetsim's host sampler too); any edit applies to
+    all of them.  Public: engine.py and fleetsim/sim.py import it."""
     scores = jax.random.uniform(skey, counts.shape)
     scores = scores + (counts == 0) * 1e3
     return jnp.argsort(scores)[:k]
+
+
+# Back-compat alias for the historical private name.
+_rank_cohort = rank_cohort
 
 
 def manual_axes(ln) -> frozenset:

@@ -75,11 +75,26 @@ def local_trainer_for_config(
     apply_fn: Callable,
     capacity: int,
     grad_sync_axes: tuple[str, ...] = (),
+    lora_dense_ok: bool = False,
 ) -> tuple[Callable, int]:
     """(local_update fn, num_steps) for one client round under ``config``.
 
-    ``grad_sync_axes``: sequence-parallel mesh axes (fed/local.py)."""
+    ``grad_sync_axes``: sequence-parallel mesh axes (fed/local.py).
+    ``lora_dense_ok``: fleetsim prices LoRA factor frames but keeps its
+    vmapped training dynamics dense by design (fleetsim/sim.py) — only
+    it may build this dense trainer under ``lora_rank > 0``."""
     c = config.fed
+    if c.lora_rank < 0:
+        raise ValueError(f"lora_rank must be >= 0, got {c.lora_rank}")
+    if c.lora_rank > 0 and not lora_dense_ok:
+        # Adapter federation lives on the socket plane (comm/worker.py ->
+        # lora_trainer_for_config); an in-process consumer reaching the
+        # dense trainer with lora on would silently train the full model.
+        raise ValueError(
+            "lora_rank > 0 requires the socket federation plane "
+            "(coordinate/worker); this in-process trainer would ignore "
+            "the adapters and train dense"
+        )
     if c.strategy == "scaffold" and c.local_optimizer != "sgd":
         raise ValueError(
             "scaffold's option-II variate refresh assumes plain SGD steps; "
@@ -115,6 +130,58 @@ def local_trainer_for_config(
         aux_loss_weight=config.model.moe_aux_weight if is_moe else 0.0,
     )
     return update_fn, num_steps
+
+
+def lora_trainer_for_config(
+    config: ExperimentConfig,
+    apply_fn: Callable,
+    capacity: int,
+) -> tuple[Callable, int]:
+    """(lora_update fn, num_steps) — factor-only twin of
+    :func:`local_trainer_for_config`, built when ``fed.lora_rank > 0``.
+    The strategy restriction (fedavg/fedprox only) is enforced by
+    ``validate_robustness``; the trainer mirrors the dense step budget
+    and optimizer so a lora run and its dense twin walk the same
+    schedule."""
+    c = config.fed
+    num_steps = num_steps_for_config(config, capacity)
+    optimizer = local_lib.make_optimizer(c.lr, c.momentum, c.local_optimizer)
+    is_moe = config.model.name.startswith("moe")
+    update_fn = local_lib.make_lora_local_update(
+        apply_fn,
+        optimizer,
+        num_steps=num_steps,
+        batch_size=c.batch_size,
+        rank=c.lora_rank,
+        alpha=c.lora_alpha,
+        prox_mu=c.prox_mu if c.strategy == "fedprox" else 0.0,
+        min_steps_fraction=c.straggler_min_fraction,
+        aux_loss_weight=config.model.moe_aux_weight if is_moe else 0.0,
+    )
+    return update_fn, num_steps
+
+
+# Tag folded into the experiment key for the A-factor init stream —
+# disjoint from every prng.py tag so factor randomness never collides
+# with data/local/dp key derivations.
+_LORA_INIT_TAG = 0x10AA
+
+
+def init_lora_factors(config: ExperimentConfig, params: Any) -> Any:
+    """Seed-deterministic factor tree for ``params`` under ``config`` —
+    the ONE derivation shared by coordinator, workers and tests, so every
+    participant reconstructs the identical A basis from the config alone
+    (B is zero everywhere; round 0 is bit-for-bit the base model)."""
+    import jax
+
+    from colearn_federated_learning_tpu.fed import lora
+    from colearn_federated_learning_tpu.utils import prng
+
+    key = jax.random.fold_in(
+        prng.experiment_key(config.run.seed), _LORA_INIT_TAG)
+    return lora.init_factors(
+        params, config.fed.lora_rank, key=key,
+        model_name=config.model.name)
 
 
 def require_stateless_strategy(config: ExperimentConfig, where: str) -> None:

@@ -44,7 +44,7 @@ from colearn_federated_learning_tpu import telemetry
 from colearn_federated_learning_tpu.fed import compression
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
-from colearn_federated_learning_tpu.fed.programs import _rank_cohort
+from colearn_federated_learning_tpu.fed.programs import rank_cohort
 from colearn_federated_learning_tpu.utils import prng, pytrees
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 from colearn_federated_learning_tpu.utils.serialization import (
@@ -157,15 +157,30 @@ class FleetSim:
             wire, meta = compression.compress_delta(zeros, scheme_down)
             self.down_frame_bytes = int(wire_frame_length(
                 wire, {"round": 0, "down": "delta", **meta}))
+        # LoRA pricing (fed/lora.py): with fed.lora_rank > 0 the real
+        # wire planes ship FACTOR frames on the uplink, so the byte
+        # estimator prices those.  The simulated training dynamics stay
+        # dense (the chunked vmap trainer is unchanged) — only the
+        # wire-cost model is adapter-aware, the same shape-only
+        # decoupling as the codec pricing above.
+        if config.fed.lora_rank > 0:
+            from colearn_federated_learning_tpu.fed import lora as lora_lib
+
+            up_zeros = jax.tree.map(np.asarray, lora_lib.init_factors(
+                params_np, config.fed.lora_rank,
+                model_name=config.model.name))
+        else:
+            up_zeros = zeros
         wire_up, meta_up = compression.compress_delta(
-            zeros, config.fed.compress,
+            up_zeros, config.fed.compress,
             topk_fraction=config.fed.topk_fraction)
         self.up_frame_bytes = int(wire_frame_length(
             wire_up, {"round": 0, "op": "train", **meta_up}))
         # Uplink fast-path savings (PR 10): per-update bytes a compressed
-        # uplink saves vs the dense train frame — same shape-only pricing
-        # the coordinator's comm.bytes_saved_uplink counter uses.
-        if config.fed.compress == "none":
+        # (or factor-only) uplink saves vs the dense train frame — same
+        # shape-only pricing the coordinator's comm.bytes_saved_uplink
+        # counter uses.
+        if config.fed.compress == "none" and config.fed.lora_rank == 0:
             self.up_saved_bytes = 0
         else:
             dense_up = int(wire_frame_length(
@@ -218,7 +233,7 @@ class FleetSim:
         params = model_registry.init_params(
             model, example_x, prng.init_key(base_key))
         local_update, num_steps = setup_lib.local_trainer_for_config(
-            config, model.apply, spec.shard_capacity)
+            config, model.apply, spec.shard_capacity, lora_dense_ok=True)
         sim = cls(
             config=config,
             local_update=local_update,
@@ -264,7 +279,7 @@ class FleetSim:
                 skey = prng.sampling_key(
                     base_key, jnp.asarray(round_idx, jnp.int32))
                 return np.asarray(
-                    _rank_cohort(skey, counts_dev, cohort)).astype(np.int64)
+                    rank_cohort(skey, counts_dev, cohort)).astype(np.int64)
             return np.arange(num_clients, dtype=np.int64)
 
         def shard_slices(ids: np.ndarray) -> tuple:

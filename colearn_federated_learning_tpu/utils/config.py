@@ -105,6 +105,35 @@ def validate_robustness(config: "ExperimentConfig") -> None:
                 "topk_max_fraction <= 1, got "
                 f"[{fed.topk_min_fraction}, {fed.topk_max_fraction}]"
             )
+    if fed.lora_rank < 0:
+        raise ValueError(f"lora_rank must be >= 0, got {fed.lora_rank}")
+    if fed.lora_rank > 0:
+        if fed.lora_alpha <= 0:
+            raise ValueError(
+                f"lora_alpha must be positive, got {fed.lora_alpha}")
+        if fed.lora_merge_every < 1:
+            raise ValueError(
+                "lora_merge_every must be >= 1, got "
+                f"{fed.lora_merge_every}"
+            )
+        if fed.compress_down != "none":
+            raise ValueError(
+                "lora_rank > 0 replaces the broadcast with a base+factor "
+                "frame; the downlink delta-cache protocol (compress_down) "
+                "does not compose with it — factor uplink compression "
+                "(fed.compress) is the supported knob"
+            )
+        if fed.strategy not in ("fedavg", "fedprox"):
+            raise ValueError(
+                "lora_rank > 0 folds FACTOR deltas, which the adaptive "
+                "server optimizers' params-shaped moment state cannot "
+                f"consume — use fedavg/fedprox, not {fed.strategy!r}"
+            )
+        # NOTE what is deliberately ALLOWED: compress="topk"/"topk8"
+        # (+feedback / adaptive density) applies the sparse codec TO THE
+        # FACTORS, and secure_agg masks the (dense) factor tree — the
+        # secure_agg x compress conflict keeps its existing wire-plane
+        # rejection (comm/worker.py __init__), identical under lora.
     if run.num_aggregators < 0:
         raise ValueError(
             f"num_aggregators must be >= 0, got {run.num_aggregators}")
@@ -260,6 +289,15 @@ class FedConfig:
     # instead of silently averaging a couple of survivors.  0 disables —
     # today's behavior, and the default.
     min_cohort_fraction: float = 0.0
+    # Rank-r LoRA adapter federation (fed/lora.py): clients train and
+    # ship ONLY low-rank factors for the partition-rule-targeted matmul
+    # params (uplink O(r·d) instead of O(model)); the server folds
+    # factor trees and merges B·A·(alpha/r) into the global model every
+    # ``lora_merge_every`` aggregations.  0 disables — round records and
+    # wire frames stay byte-identical to builds without the feature.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_merge_every: int = 10
 
 
 @dataclasses.dataclass(frozen=True)

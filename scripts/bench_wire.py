@@ -15,7 +15,13 @@ the bench CNN shape and measures, per round:
   ``comm.uplink_densify_avoided_total`` deltas per scheme, plus the
   streaming-fold overlap (``phase_fold_overlap_s``) so the O(k) sparse
   fold's per-contribution cost is visible next to the dense fold's;
-- round latency.
+- round latency;
+- the LoRA sweep (``--lora-ranks``): rank-r factor frames priced against
+  the dense update frame at the committed BERT bench config
+  (``agnews_bert_fedavg``, BERT-base) via shape-only frame math — no
+  110M-param alloc — plus one real 2-worker factor-uplink federation at
+  a tiny BERT shape to prove the plane end to end (serialize-once
+  broadcast, factor fold, periodic server merge).
 
 One JSON summary line per configuration is written to
 ``results/wire_bench.jsonl`` (PERF.md "Wire plane" and the SLO sentinel
@@ -25,6 +31,8 @@ Usage (CPU):
     JAX_PLATFORMS=cpu python scripts/bench_wire.py
     JAX_PLATFORMS=cpu python scripts/bench_wire.py \\
         --cohorts 2,4 --schemes none,topk --feedback off,on --rounds 5
+    JAX_PLATFORMS=cpu python scripts/bench_wire.py \\
+        --lora-ranks 4 --lora-only --rounds 3   # CI lora-smoke shape
 """
 
 from __future__ import annotations
@@ -232,6 +240,158 @@ def run_bench(n_workers: int, scheme_down: str, scheme_up: str,
     }
 
 
+def lora_bench_config(n_workers: int, rank: int) -> ExperimentConfig:
+    """Tiny BERT on the synthetic agnews_tiny split: small enough to run a
+    real 2-worker factor-uplink federation in seconds on CPU, transformer
+    enough that the partition-rule-driven targeting (attention QKV/out,
+    MLP, embeddings) is exercised for real."""
+    return ExperimentConfig(
+        data=DataConfig(dataset="agnews_tiny", num_clients=n_workers,
+                        partition="iid"),
+        model=ModelConfig(name="bert", num_classes=4, width=32, depth=2,
+                          num_heads=2, seq_len=64, vocab_size=2000),
+        fed=FedConfig(strategy="fedavg", rounds=1, cohort_size=0,
+                      local_steps=2, batch_size=16, lr=0.05, momentum=0.0,
+                      lora_rank=rank, lora_alpha=16.0, lora_merge_every=2),
+        run=RunConfig(name="bench_wire_lora", backend="cpu", seed=0),
+    )
+
+
+def run_lora_bench(rank: int, rounds: int, warmup_timeout: float,
+                   round_timeout: float) -> dict:
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+    from colearn_federated_learning_tpu.comm.coordinator import (
+        FederatedCoordinator,
+    )
+    from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+    from colearn_federated_learning_tpu.fed import lora as lora_lib
+    from colearn_federated_learning_tpu.models import registry as models
+    from colearn_federated_learning_tpu.utils.config import get_config
+    from colearn_federated_learning_tpu.utils.serialization import (
+        wire_frame_length,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    # --- Analytic pricing at the committed BERT bench config (BERT-base
+    # on agnews, utils/config.py): eval_shape gives the param shape tree
+    # without materializing ~110M params, and wire_frame_length is
+    # shape-only, so broadcast-zero views price both frames for free.
+    bert_cfg = get_config("agnews_bert_fedavg").model
+    model = models.build_model(bert_cfg)
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, bert_cfg.seq_len), jnp.int32),
+                             train=False),
+        jax.random.PRNGKey(0))["params"]
+    params_view = jax.tree.map(
+        lambda l: np.broadcast_to(np.zeros((), np.dtype(l.dtype)), l.shape),
+        shapes)
+    dense_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(params_view))
+    # key=None -> zero factors: the template carries exactly the shapes
+    # a worker's factor-delta reply would.
+    factors_view = lora_lib.init_factors(params_view, rank,
+                                         model_name=bert_cfg.name)
+    factor_params = lora_lib.count_factor_params(factors_view)
+    meta = {"round": 1, "op": "train", "compress": "none"}
+    dense_len = wire_frame_length(params_view, meta)
+    factor_len = wire_frame_length(factors_view, meta)
+
+    # --- One real factor-uplink federation at the tiny BERT shape.
+    n_workers = 2
+    config = lora_bench_config(n_workers, rank)
+    reg = telemetry.get_registry()
+
+    broker = MessageBroker().start()
+    workers = []
+    coord = None
+    per_round: list[dict] = []
+    try:
+        workers = [
+            DeviceWorker(config, i, broker.host, broker.port).start()
+            for i in range(n_workers)
+        ]
+        coord = FederatedCoordinator(config, broker.host, broker.port,
+                                     round_timeout=warmup_timeout,
+                                     want_evaluator=False)
+        coord.enroll(min_devices=n_workers, timeout=30.0)
+        coord.trainers.sort(key=lambda d: int(d.device_id))
+        for w in workers:
+            w.await_role(timeout=10.0)
+
+        coord.run_round()                 # warmup: jit compile
+        coord.round_timeout = round_timeout
+        for _ in range(rounds):
+            before = {c: reg.counter(c).value for c in _COUNTERS}
+            rec = coord.run_round()
+            delta = {c: reg.counter(c).value - before[c] for c in _COUNTERS}
+            per_round.append({
+                "encodes": int(delta["comm.broadcast_encode_total"]),
+                "bytes_sent": int(delta["comm.bytes_sent"]),
+                "bytes_received": int(delta["comm.bytes_received"]),
+                "bytes_saved_uplink": int(
+                    delta["comm.bytes_saved_uplink"]),
+                "resyncs": int(delta["comm.resync_total"]),
+                "gather_avoided": int(
+                    delta["comm.gather_bytes_avoided_total"]),
+                "sends": int(rec.get("completed", 0)),
+                "lora_merged": bool(rec.get("lora_merged", False)),
+                "round_time_s": rec["round_time_s"],
+            })
+    finally:
+        for w in workers:
+            w.stop()
+        broker.stop()
+        if coord is not None:
+            coord.close()
+
+    encodes = [r["encodes"] for r in per_round]
+    return {
+        "bench": "wire_lora",
+        # Priced model (the headline ratio) vs the smoke model the real
+        # federation ran on.
+        "model": "bert-base",
+        "dataset": "agnews",
+        "smoke_model": "bert-tiny",
+        "smoke_dataset": "agnews_tiny",
+        "cohort": n_workers,
+        "scheme_down": "none",
+        "scheme_up": "none",
+        "feedback": False,
+        "tp_size": 1,
+        "rounds": rounds,
+        "lora_rank": rank,
+        "lora_alpha": 16.0,
+        "dense_params": int(dense_params),
+        "factor_params": int(factor_params),
+        # Serialize-once must hold for the composite {base, factors}
+        # broadcast too — the whereclause-free SLO sentinel reads this.
+        "encodes_per_round": max(encodes),
+        "encodes_per_round_before": n_workers,
+        # Shape-only frame ratio/reduction at BERT-base — what the
+        # wire-lora-uplink-ratio sentinel gates.
+        "uplink_frame_bytes": int(factor_len),
+        "uplink_dense_bytes": int(dense_len),
+        "uplink_bytes_ratio": round(factor_len / dense_len, 4),
+        "uplink_reduction_x": round(dense_len / factor_len, 2),
+        # Measured smoke-run ground truth: factor replies really are what
+        # crossed the wire, and the server really merged.
+        "bytes_sent_per_round": int(statistics.mean(
+            r["bytes_sent"] for r in per_round)),
+        "bytes_received_per_round": int(statistics.mean(
+            r["bytes_received"] for r in per_round)),
+        "bytes_saved_uplink_per_round": int(statistics.mean(
+            r["bytes_saved_uplink"] for r in per_round)),
+        "lora_merges": sum(1 for r in per_round if r["lora_merged"]),
+        "resyncs_total": sum(r["resyncs"] for r in per_round),
+        "round_time_s_mean": round(statistics.mean(
+            r["round_time_s"] for r in per_round), 4),
+        "per_round": per_round,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rounds", type=int, default=5,
@@ -251,6 +411,13 @@ def main(argv=None) -> int:
                     help="comma-separated server tp_size values; sizes > 1 "
                          "shard the global model over a (model,) mesh and "
                          "are swept on the 'none' scheme only")
+    ap.add_argument("--lora-ranks", default="4,8",
+                    help="comma-separated LoRA ranks priced at the "
+                         "BERT-base bench config (+ one real tiny-BERT "
+                         "factor-uplink federation per rank); empty "
+                         "string skips the sweep")
+    ap.add_argument("--lora-only", action="store_true",
+                    help="run only the --lora-ranks sweep (CI lora-smoke)")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "results", "wire_bench.jsonl"))
@@ -295,25 +462,57 @@ def main(argv=None) -> int:
                     "dense frame")
         return row
 
-    # Downlink matrix (unchanged axes): cohorts × down-schemes × tp.
-    for n in cohorts:
-        for scheme_down in (s.strip() for s in args.down_schemes.split(",")
-                            if s):
-            # Sharded-server rows ride on the uncompressed scheme (the
-            # encode path is byte-identical either way; one sweep axis at
-            # a time keeps the matrix readable).
-            for tp in (tp_sizes if scheme_down == "none" else [1]):
-                bench_row(n, scheme_down, "none", False, tp)
+    def lora_row(rank):
+        t0 = time.time()
+        row = run_lora_bench(rank, args.rounds, args.warmup_timeout,
+                             args.round_timeout)
+        row["bench_wall_s"] = round(time.time() - t0, 1)
+        rows.append(row)
+        print(json.dumps({k: v for k, v in row.items()
+                          if k != "per_round"}))
+        if row["encodes_per_round"] != 1:
+            raise SystemExit(
+                f"FAIL: {row['encodes_per_round']} broadcast encodes per "
+                f"round at lora rank {rank} (want exactly 1)")
+        if row["uplink_reduction_x"] < 25.0:
+            raise SystemExit(
+                f"FAIL: rank-{rank} factor uplink reduction "
+                f"{row['uplink_reduction_x']}x < 25x vs the dense "
+                "BERT-base frame")
+        if row["bytes_saved_uplink_per_round"] <= 0:
+            raise SystemExit(
+                f"FAIL: rank-{rank} smoke run saved no uplink bytes "
+                "(factor replies not engaged)")
+        if row["lora_merges"] < 1:
+            raise SystemExit(
+                f"FAIL: rank-{rank} smoke run never merged factors into "
+                "the base model (lora_merge_every not engaged)")
+        return row
 
-    # Uplink sweep at the largest cohort: scheme × feedback.  Feedback on
-    # a lossless uplink is a no-op, so "none" only appears as the
-    # baseline rows above.
-    n_up = max(cohorts)
-    for scheme_up in (s.strip() for s in args.schemes.split(",") if s):
-        if scheme_up == "none":
-            continue
-        for fb_s in (s.strip() for s in args.feedback.split(",") if s):
-            bench_row(n_up, "none", scheme_up, fb_s == "on", 1)
+    if not args.lora_only:
+        # Downlink matrix (unchanged axes): cohorts × down-schemes × tp.
+        for n in cohorts:
+            for scheme_down in (s.strip()
+                                for s in args.down_schemes.split(",") if s):
+                # Sharded-server rows ride on the uncompressed scheme (the
+                # encode path is byte-identical either way; one sweep axis
+                # at a time keeps the matrix readable).
+                for tp in (tp_sizes if scheme_down == "none" else [1]):
+                    bench_row(n, scheme_down, "none", False, tp)
+
+        # Uplink sweep at the largest cohort: scheme × feedback.  Feedback
+        # on a lossless uplink is a no-op, so "none" only appears as the
+        # baseline rows above.
+        n_up = max(cohorts)
+        for scheme_up in (s.strip() for s in args.schemes.split(",") if s):
+            if scheme_up == "none":
+                continue
+            for fb_s in (s.strip() for s in args.feedback.split(",") if s):
+                bench_row(n_up, "none", scheme_up, fb_s == "on", 1)
+
+    # LoRA factor-uplink sweep: rank-r adapter frames vs the dense frame.
+    for rank_s in (s.strip() for s in args.lora_ranks.split(",") if s):
+        lora_row(int(rank_s))
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:

@@ -18,6 +18,7 @@ LOG=results/tpu_retry_$(date +%H%M%S).log
 mkdir -p "$STATE" results
 MAX_SWEEPS=${1:-40}       # sweeps that actually ATTEMPT work (tunnel up)
 MAX_IDLE_S=${2:-43200}    # total seconds allowed waiting on a dead tunnel
+MAX_FAILS=${3:-3}         # park a task after N consecutive tunnel-UP failures
 idle_s=0
 
 probe() {
@@ -52,6 +53,11 @@ while [ "$sweep" -lt "$MAX_SWEEPS" ]; do
     tmo=${rest%%|*}
     cmd=${rest#*|}
     [ -f "$STATE/$name.done" ] && continue
+    # Parked: the task failed MAX_FAILS times in a row WITH the tunnel up
+    # — a deterministic failure (bad flag, OOM, broken test), not tunnel
+    # flap.  Retrying forever would burn the sweep budget the healthy
+    # tasks need; `rm $STATE/<name>.parked` re-queues it after a fix.
+    [ -f "$STATE/$name.parked" ] && continue
     pending=$((pending + 1))
     plat=$(probe)
     if [ "$plat" != "tpu" ]; then
@@ -74,9 +80,26 @@ while [ "$sweep" -lt "$MAX_SWEEPS" ]; do
     echo "rc=$rc ($name)" | tee -a "$LOG"
     if [ "$rc" -eq 0 ]; then
       date > "$STATE/$name.done"
+      rm -f "$STATE/$name.fails"
+    else
+      # Count consecutive tunnel-UP failures only (the probe above just
+      # said "tpu", so this rc is the task's own fault); a dead tunnel
+      # never reaches this branch, so flap can't park anything.
+      fails=$(( $(cat "$STATE/$name.fails" 2>/dev/null || echo 0) + 1 ))
+      echo "$fails" > "$STATE/$name.fails"
+      if [ "$fails" -ge "$MAX_FAILS" ]; then
+        { date; echo "rc=$rc after $fails consecutive tunnel-up failures"; } \
+          > "$STATE/$name.parked"
+        echo "[retry-queue] PARKED $name after $fails consecutive failures (rm $STATE/$name.parked to re-queue)" | tee -a "$LOG"
+      fi
     fi
   done
   if [ "$pending" -eq 0 ]; then
+    parked=$(ls "$STATE"/*.parked 2>/dev/null | wc -l)
+    if [ "$parked" -gt 0 ]; then
+      echo "[retry-queue] done after sweep $sweep with $parked PARKED task(s): $(ls "$STATE"/*.parked 2>/dev/null | xargs -n1 basename | sed 's/\.parked//' | tr '\n' ' ')" | tee -a "$LOG"
+      exit 3
+    fi
     echo "[retry-queue] all tasks done after sweep $sweep" | tee -a "$LOG"
     exit 0
   fi

@@ -90,6 +90,7 @@ def test_full_3d_composition_matches_vmap(cpu_devices):
                                    rtol=2e-3, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_cohort64_over_16_devices():
     """Mesh path at cohort 64 over 16 virtual devices, 128 resident
     clients: every sampled slot must be a real client (interleaved
