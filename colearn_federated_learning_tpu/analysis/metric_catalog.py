@@ -122,6 +122,9 @@ COUNTERS = (
     "flight.dumps_total",            # flight-recorder dump writes
     "export.scrapes_total",          # /metrics + /snapshot.json hits
     "export.events_written_total",   # JSONL event-stream lines
+    # convergence observatory (telemetry/convergence.py export_metrics):
+    # per-fold trend classification census, labeled {trend=progress|...}
+    "learn.trend_total",
 )
 
 # Gauges -------------------------------------------------------------------
@@ -165,6 +168,13 @@ GAUGES = (
     "health.devices_tracked",
     "health.device_score",           # labeled {device=<id>}: offender rank
     "health.device_latency_ewma_s",  # labeled {device=<id>}
+    # convergence observatory (telemetry/convergence.py export_metrics):
+    # learning-health signals computed from the materialized aggregate
+    "learn.update_norm",             # ‖mean update‖ of the latest fold
+    "learn.update_norm_ewma",        # trend baseline the classifier uses
+    "learn.step_size",               # ‖mean update‖ × server_lr
+    "learn.cos_prev",                # cosine to the previous mean update
+    "learn.cohort_skew",             # 1 − min cohort-centroid cosine
 )
 
 # Histograms ---------------------------------------------------------------
@@ -179,6 +189,8 @@ HISTOGRAMS = (
     "fleetsim.async_staleness",      # same, on the simulated clock
     "fleetsim.round_time_s",
     "comm.agg_fold_time_s",  # labeled {agg=<id>}: middle-tier slice folds
+    # convergence observatory: distribution of per-fold update norms
+    "learn.update_norm_dist",
 )
 
 # Counters whose soak-window delta faults/soak.py reports (a curated
@@ -229,3 +241,67 @@ def is_known(name: str) -> bool:
     if base in METRICS:
         return True
     return any(base.startswith(w) for w in _WILDCARDS)
+
+
+# ------------------------------------------------------------ record keys --
+# Round/aggregation-record keys the comm/ and fleetsim/ hot paths may
+# stamp (comm/coordinator.py, comm/async_coordinator.py,
+# fleetsim/sim.py).  The CL016 lint rule (analysis/rules.py) validates
+# every literal key stored into those records against this tuple, so a
+# record-key typo ("train_los") is a lint error instead of a silently
+# forked series downstream sentinels and `colearn converge` never match.
+RECORD_KEYS_LIST = (
+    # sync federation round record (comm/coordinator.py)
+    "round", "completed", "cohort", "dropped", "evicted", "train_loss",
+    "total_weight", "phase_broadcast_collect_s", "phase_aggregate_s",
+    "phase_fold_overlap_s", "round_time_s", "retries",
+    # conditional sync keys (feature-gated; default records byte-identical)
+    "unmask_failed", "skipped_quorum", "bytes_saved_uplink",
+    "uplink_densify_avoided", "lora_merged", "aggregators",
+    "phase_agg_fold_s", "agg_failovers", "dp_epsilon", "dp_delta",
+    # per-client evaluation report (comm/coordinator.py)
+    "num_clients_evaluated", "per_client",
+    # challenge-on-resume report (comm/coordinator.py
+    # verify_resumed_devices)
+    "verified", "rejected",
+    # buffered-async aggregation record (comm/async_coordinator.py)
+    "aggregation", "model_version", "buffer_size", "staleness_mean",
+    "staleness_max", "discarded", "contributors", "agg_time_s",
+    "phase_collect_s", "phase_apply_s",
+    # observe-gated async keys
+    "mass_folded", "mass_discarded", "arrival_rate_per_s",
+    "staleness_p50", "staleness_p90", "staleness_p99", "pruned",
+    "dp_z_eff",
+    # fleetsim sync round record (fleetsim/sim.py run_round)
+    "cohort_requested", "clients_trained", "bytes_down_est",
+    "bytes_up_est", "bytes_gather_avoided_est", "bytes_up_saved_est",
+    "available_fraction", "straggled", "corrupted",
+    # fleetsim async record extras (fleetsim/sim.py fit_async)
+    "sim_time_min", "arrival_rate_per_min", "agg_rate_per_min",
+    "wasted_updates_total", "arrival_rate_ewma_per_min", "pruned_total",
+    # fleetsim compile-census report (DeviceFleetSim.compile_counts)
+    "chunk", "finish", "fold", "obs_chunk",
+    # health-ledger summary keys (telemetry/health.py health_record_keys)
+    "health_devices", "health_lat_p99_s", "health_worst_device",
+    "health_worst_score",
+    # convergence observatory (telemetry/convergence.py; --learn-observe)
+    "conv_update_norm",      # ‖mean update‖ of the materialized aggregate
+    "conv_step_size",        # ‖mean update‖ × server_lr
+    "conv_norm_ewma",        # trend baseline at classification time
+    "conv_trend",            # warmup|progress|plateau|divergence|oscillation
+    "conv_cos_prev",         # cosine to previous update (absent round 0)
+    "conv_norm_median",      # fleetsim per-device skew (updates visible)
+    "conv_norm_p90",
+    "conv_norm_anomalies",   # devices with norm > anomaly_ratio × median
+    "conv_cohort_skew",      # 1 − min cohort-centroid cosine vs aggregate
+    "conv_cohort_cos_min",
+)
+
+RECORD_KEYS: frozenset = frozenset(RECORD_KEYS_LIST)
+
+assert len(RECORD_KEYS) == len(RECORD_KEYS_LIST), "duplicate record key"
+
+
+def is_known_record_key(key: str) -> bool:
+    """True when ``key`` is a declared round-record key."""
+    return key in RECORD_KEYS

@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL015).
+"""The colearn rule set (CL001–CL016).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -928,3 +928,69 @@ class UninterruptibleBackoffSleep(Rule):
                 "bare time.sleep() in a retry/dispatch loop cannot be "
                 "interrupted by close()/stop(): the backoff outlives "
                 "teardown; wait on the stop Event so shutdown wakes it")
+
+
+# ----------------------------------------------------------------- CL016 --
+@register
+class RecordKeyDrift(Rule):
+    """Every literal round-record key the comm/fleetsim hot paths stamp
+    must be declared in analysis/metric_catalog.RECORD_KEYS — a typo'd
+    key ("train_los") forks a series that sentinels, `colearn converge`,
+    and the bench harness silently never match."""
+
+    id = "CL016"
+    title = "round-record key not declared in the catalog"
+    hint = ("add it to RECORD_KEYS in analysis/metric_catalog.py "
+            "(or fix the typo)")
+
+    # The hot-path files whose rec/out dicts ARE round records.  Other
+    # comm files use `out` for wire headers etc. — out of scope.
+    _FILES = {"coordinator.py", "async_coordinator.py", "sim.py"}
+    _RECORD_NAMES = {"rec", "out", "record"}
+
+    def _is_record(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Name)
+                and node.id in self._RECORD_NAMES)
+
+    def _check_key(self, ctx, node, key) -> Iterator[Finding]:
+        if isinstance(key, str) and not metric_catalog.is_known_record_key(
+                key):
+            yield self.finding(
+                ctx, node,
+                f"record key {key!r} is not in RECORD_KEYS")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not (ctx.in_dir("comm") or ctx.in_dir("fleetsim")):
+            return
+        if ctx.parts[-1] not in self._FILES:
+            return
+        for node in ast.walk(ctx.tree):
+            # rec["key"] = ... / out["key"] = ...
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Subscript)
+                            and self._is_record(tgt.value)
+                            and isinstance(tgt.slice, ast.Constant)):
+                        yield from self._check_key(
+                            ctx, node, tgt.slice.value)
+                    # rec = {"key": ...} / out = {...}
+                    if self._is_record(tgt) and isinstance(
+                            node.value, ast.Dict):
+                        for k in node.value.keys:
+                            if isinstance(k, ast.Constant):
+                                yield from self._check_key(
+                                    ctx, node, k.value)
+            # rec.update(key=..., ...) / out.update({"key": ...})
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "update"
+                    and self._is_record(node.func.value)):
+                for kw in node.keywords:
+                    if kw.arg is not None:       # **expr stays unvalidated
+                        yield from self._check_key(ctx, node, kw.arg)
+                for arg in node.args:
+                    if isinstance(arg, ast.Dict):
+                        for k in arg.keys:
+                            if isinstance(k, ast.Constant):
+                                yield from self._check_key(
+                                    ctx, node, k.value)

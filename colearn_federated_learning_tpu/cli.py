@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
 from colearn_federated_learning_tpu.utils.config import (
@@ -179,6 +180,13 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "fleetsim durably record deadline misses, "
                         "retries, latency sketches per device "
                         "(`colearn health` reads it)")
+    p.add_argument("--learn-observe", action="store_true", default=None,
+                   help="convergence observatory "
+                        "(telemetry/convergence.py): stamp conv_* "
+                        "learning-health keys (update norm, cosine to "
+                        "the previous update, EWMA trend) on round "
+                        "records and export learn.* metrics; `colearn "
+                        "converge` renders the report")
     p.add_argument("--fault-plan", default=None,
                    help="JSON fault-plan file (faults/plan.py) installed "
                         "on this process's transport — deterministic "
@@ -269,7 +277,7 @@ _RUN_KEYS = {"backend", "seed", "tp_size", "eval_every", "log_every",
              "evict_after", "worker_enroll_timeout", "comm_retries",
              "comm_backoff_base", "comm_backoff_max", "fault_plan",
              "fault_seed", "num_aggregators", "agg_heartbeat_timeout",
-             "health_dir"}
+             "health_dir", "learn_observe"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
@@ -864,7 +872,8 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
                       compress=args.compress,
                       compress_down=args.compress_down or "none",
                       lora_rank=args.lora_rank, lora_alpha=args.lora_alpha),
-        run=RunConfig(name="fleetsim", seed=args.seed))
+        run=RunConfig(name="fleetsim", seed=args.seed,
+                      learn_observe=bool(args.learn_observe)))
     plan = None
     if args.fault_plan:
         from colearn_federated_learning_tpu import faults
@@ -1119,6 +1128,44 @@ def cmd_health(args: argparse.Namespace) -> int:
     else:
         print(telemetry.render_health(devices, top=args.top))
     return 0 if devices else 1
+
+
+def cmd_converge(args: argparse.Namespace) -> int:
+    """Round-over-round learning report from committed JSONL: any file
+    or results dir whose records carry conv_* keys (a --learn-observe
+    run, an event stream, a bench log)."""
+    import glob
+    from colearn_federated_learning_tpu import telemetry
+
+    paths = ([args.results] if os.path.isfile(args.results)
+             else sorted(glob.glob(
+                 os.path.join(args.results, "**", "*.jsonl"),
+                 recursive=True)))
+    records: list = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except OSError as e:
+            print(f"colearn converge: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    if not paths:
+        print(f"colearn converge: no JSONL under {args.results}",
+              file=sys.stderr)
+        return 2
+    report = telemetry.render_convergence_report(records)
+    print(report)
+    return 0 if not report.startswith("no learning signals") else 1
 
 
 def cmd_configs(_args: argparse.Namespace) -> int:
@@ -1417,6 +1464,11 @@ def main(argv: list[str] | None = None) -> int:
     p_fleet.add_argument("--async-probation", type=int, default=8,
                          help="async mode: aggregations a pruned device "
                               "sits out before re-admission")
+    p_fleet.add_argument("--learn-observe", action="store_true",
+                         help="convergence observatory: stamp conv_* "
+                              "learning-health keys (update norm / cosine "
+                              "/ trend, per-cohort drift skew) on round "
+                              "records; `colearn converge` renders them")
     p_fleet.set_defaults(fn=cmd_fleetsim)
 
     p_lint = sub.add_parser("lint",
@@ -1504,6 +1556,15 @@ def main(argv: list[str] | None = None) -> int:
     p_health.add_argument("--format", choices=["text", "json"],
                           default="text")
     p_health.set_defaults(fn=cmd_health)
+
+    p_conv = sub.add_parser("converge",
+                            help="round-over-round learning report from "
+                                 "a --learn-observe run's JSONL (update "
+                                 "norm / cosine / trend per round)")
+    p_conv.add_argument("results",
+                        help="JSONL file, or directory searched "
+                             "recursively for *.jsonl")
+    p_conv.set_defaults(fn=cmd_converge)
 
     p_bench = sub.add_parser("bench", help="run the headline benchmark")
     p_bench.add_argument("--rounds", type=int, default=20)

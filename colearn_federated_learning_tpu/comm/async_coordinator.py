@@ -184,6 +184,14 @@ class AsyncFederatedCoordinator:
         self.buffer_size = buffer_size
         self.observe_records = bool(observe) or self.auto_buffer
         self.auto_interval_s = float(auto_interval_s)
+        # Convergence observatory (telemetry/convergence.py): aggregate-
+        # level learning signals per applied buffer — a staleness-
+        # poisoned run shows up as oscillation/divergence long before the
+        # final loss does.  Gated on run.learn_observe; default records
+        # stay byte-identical (pinned by test).
+        self._learn = None
+        if config.run.learn_observe:
+            self._learn = telemetry.ConvergenceObservatory()
         # Seeded-EWMA arrival-rate estimator (telemetry/arrival.py): the
         # pumps observe every successful dispatch on the monotonic clock;
         # auto-K and the per-aggregation gauges read the fleet rate.
@@ -764,6 +772,23 @@ class AsyncFederatedCoordinator:
                     with self._version_cv:
                         self.version += 1
                         self._version_cv.notify_all()
+                conv_sig = None
+                if self._learn is not None:
+                    # Aggregate-level learning signals; a discarded
+                    # (sub-quorum) buffer observes nothing and leaves
+                    # the trend state untouched.
+                    conv_sig = self._learn.observe(
+                        mean_delta, lr=self.config.fed.server_lr)
+                    if conv_sig:
+                        apply_sp.attrs["conv_update_norm"] = (
+                            conv_sig["conv_update_norm"])
+                        apply_sp.attrs["conv_trend"] = (
+                            conv_sig["conv_trend"])
+                        if "conv_cos_prev" in conv_sig:
+                            apply_sp.attrs["conv_cos_prev"] = (
+                                conv_sig["conv_cos_prev"])
+                        self._learn.export_metrics(
+                            telemetry.get_registry(), conv_sig)
             agg_sp.attrs["folded"] = len(staleness)
             agg_sp.attrs["discarded"] = discarded
             agg_sp.attrs["link_folds"] = fold_span_ids
@@ -823,6 +848,11 @@ class AsyncFederatedCoordinator:
         if self.health is not None:
             fleet = self._health_async_feed()
             rec.update(telemetry.health_record_keys(fleet))
+        if conv_sig:
+            # conv_* learning-health keys only under --learn-observe —
+            # default aggregation records stay byte-identical (pinned by
+            # test).
+            rec.update(conv_sig)
         self.history.append(rec)
         return rec
 

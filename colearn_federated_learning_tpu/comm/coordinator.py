@@ -273,6 +273,14 @@ class FederatedCoordinator:
         if config.run.health_dir:
             self.health = telemetry.HealthLedger(config.run.health_dir,
                                                  "coordinator")
+        # Convergence observatory (telemetry/convergence.py): aggregate-
+        # level learning signals only — under secure aggregation the
+        # server never sees an individual update, and the observatory
+        # needs none.  Gated on run.learn_observe; default round records
+        # stay byte-identical (pinned by test).
+        self._learn = None
+        if config.run.learn_observe:
+            self._learn = telemetry.ConvergenceObservatory()
         # RDP accounting mirrors the engine's; each round is charged with
         # the ACTUAL cohort fraction and REALIZED noise (membership is
         # elastic here and stragglers drop mid-round).
@@ -969,6 +977,22 @@ class FederatedCoordinator:
                     self.server_state = strategies.server_update(
                         self.server_state, mean_delta, self.config.fed
                     )
+            conv_sig = None
+            if self._learn is not None:
+                # Learning-health signals from the (possibly factor-tree)
+                # aggregate; a no-op round (quorum skip / unmask failure)
+                # observes nothing and leaves the trend state untouched.
+                conv_sig = self._learn.observe(
+                    mean_delta, lr=self.config.fed.server_lr)
+                if conv_sig:
+                    agg_sp.attrs["conv_update_norm"] = (
+                        conv_sig["conv_update_norm"])
+                    agg_sp.attrs["conv_trend"] = conv_sig["conv_trend"]
+                    if "conv_cos_prev" in conv_sig:
+                        agg_sp.attrs["conv_cos_prev"] = (
+                            conv_sig["conv_cos_prev"])
+                    self._learn.export_metrics(telemetry.get_registry(),
+                                               conv_sig)
         evicted = self._note_round_outcome(cohort_full, dropped)
         rec = {
             "round": r,
@@ -1034,6 +1058,10 @@ class FederatedCoordinator:
             # health_* summary keys exist ONLY when the plane is on —
             # default round records stay byte-identical.
             rec.update(telemetry.health_record_keys(fleet))
+        if conv_sig:
+            # conv_* learning-health keys only under --learn-observe —
+            # default round records stay byte-identical (pinned by test).
+            rec.update(conv_sig)
         return rec
 
     # ---- health plane (telemetry/health.py) ------------------------------

@@ -59,12 +59,24 @@ def lr_scale_for_round(cfg: FedConfig, round_idx) -> jnp.ndarray:
       ``rounds`` horizon.
     - warmup_cosine: linear ramp over ``warmup_rounds`` (round r trains at
       (r+1)/warmup — never 0), then the cosine leg over the remainder.
+
+    Chaos overlay: ``lr_spike_round >= 0`` multiplies the factor by
+    ``lr_spike_multiplier`` for exactly that round — the injected fault
+    the convergence observatory's divergence sentinel must catch
+    (scripts/learn_smoke.py).  The gate is config-static, so default
+    graphs are untouched.
     """
     if cfg.lr_schedule not in SCHEDULES:
         raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}; "
                          f"use one of {SCHEDULES}")
+    spiked = cfg.lr_spike_round >= 0 and cfg.lr_spike_multiplier != 1.0
     if cfg.lr_schedule == "constant":
-        return None
+        if not spiked:
+            return None
+        r = jnp.asarray(round_idx, jnp.float32)
+        return jnp.where(r == jnp.float32(cfg.lr_spike_round),
+                         jnp.float32(cfg.lr_spike_multiplier),
+                         jnp.float32(1.0))
     r = jnp.asarray(round_idx, jnp.float32)
     floor = jnp.float32(cfg.lr_min_fraction)
     warm = float(cfg.warmup_rounds if cfg.lr_schedule == "warmup_cosine"
@@ -73,8 +85,11 @@ def lr_scale_for_round(cfg: FedConfig, round_idx) -> jnp.ndarray:
     prog = jnp.clip((r - warm) / horizon, 0.0, 1.0)
     cos = floor + (1.0 - floor) * 0.5 * (1.0 + jnp.cos(jnp.pi * prog))
     if warm > 0:
-        ramp = jnp.minimum((r + 1.0) / warm, 1.0)
-        return jnp.where(r < warm, ramp, cos)
+        cos = jnp.where(r < warm, jnp.minimum((r + 1.0) / warm, 1.0), cos)
+    if spiked:
+        cos = cos * jnp.where(r == jnp.float32(cfg.lr_spike_round),
+                              jnp.float32(cfg.lr_spike_multiplier),
+                              jnp.float32(1.0))
     return cos
 
 

@@ -130,6 +130,16 @@ class FleetSim:
         if config.run.health_dir:
             self.health = telemetry.HealthLedger(config.run.health_dir,
                                                  "fleetsim")
+        # Convergence observatory (telemetry/convergence.py): updates are
+        # simulation-local, so this plane legitimately sees per-device
+        # norms and per-cohort centroids — the attribution secure
+        # aggregation denies the socket planes.  Off by default: no
+        # observatory, no obs program, round records byte-identical.
+        self._learn = None
+        self._obs_chunk_fn = None
+        self._population = None           # set by from_population
+        if config.run.learn_observe:
+            self._learn = telemetry.ConvergenceObservatory()
 
         # CompileTracker on every jitted program makes the "one compile
         # per sweep shape" claim a measurable invariant (compile_counts
@@ -252,6 +262,9 @@ class FleetSim:
                 traffic.available_mask(r).mean()),
         )
         sim._traffic = traffic
+        # Cohort drift attribution needs each device's seeded home class
+        # (population.home_classes) — only this constructor has one.
+        sim._population = population
         return sim
 
     @classmethod
@@ -304,17 +317,25 @@ class FleetSim:
         )
 
     # -------------------------------------------------- compiled pieces --
-    def _build_chunk_fn(self):
+    def _build_chunk_fn(self, observe: bool = False, num_classes: int = 1):
         """One chunk's training + weighting, jit-compiled once (static
         chunk shape): vmap(local_update) -> weighted partial sums.  The
         engine's cohort_step semantics, minus the engine-only hooks the
-        config validator excluded."""
+        config validator excluded.
+
+        ``observe=True`` builds the convergence-observatory variant
+        (telemetry/convergence.py): same training, plus per-device
+        update norms and per-home-class weighted delta sums (``classes``
+        carries each device's seeded non-IID cluster) — the raw material
+        for cohort drift attribution.  A separate jitted program, so the
+        default plane's ``compile_counts`` contract is untouched.
+        """
         update = self.local_update
         fed = self.config.fed
         num_steps = self.num_steps
 
-        def chunk_fn(key, params, x, y, counts, ids, round_idx, budgets,
-                     keep):
+        def core(key, params, x, y, counts, ids, round_idx, budgets,
+                 keep):
             # Per-(client, round) keys off the GLOBAL device id:
             # placement/chunking-independent determinism (utils/prng.py).
             keys = jax.vmap(
@@ -340,13 +361,47 @@ class FleetSim:
             )(params, x, y, counts, keys, budgets, lr_scale)
             contrib = res.completed & (res.num_examples > 0) & keep
             weights = res.num_examples.astype(jnp.float32) * contrib
+            return res, contrib, weights
+
+        def chunk_fn(key, params, x, y, counts, ids, round_idx, budgets,
+                     keep):
+            res, contrib, weights = core(key, params, x, y, counts, ids,
+                                         round_idx, budgets, keep)
             wsum = pytrees.tree_weighted_sum(res.delta, weights)
             total_w = jnp.sum(weights)
             loss_sum = jnp.sum(res.mean_loss * weights)
             n_comp = jnp.sum(contrib.astype(jnp.int32))
             return wsum, total_w, loss_sum, n_comp
 
-        return jax.jit(chunk_fn)
+        if not observe:
+            return jax.jit(chunk_fn)
+
+        def obs_chunk_fn(key, params, x, y, counts, ids, round_idx,
+                         budgets, keep, classes):
+            res, contrib, weights = core(key, params, x, y, counts, ids,
+                                         round_idx, budgets, keep)
+            wsum = pytrees.tree_weighted_sum(res.delta, weights)
+            total_w = jnp.sum(weights)
+            loss_sum = jnp.sum(res.mean_loss * weights)
+            n_comp = jnp.sum(contrib.astype(jnp.int32))
+            # Per-device update norm, zeroed for non-contributors (and
+            # for padding lanes, whose keep mask is False).
+            sq = sum(jnp.sum(jnp.square(leaf),
+                             axis=tuple(range(1, leaf.ndim)))
+                     for leaf in jax.tree.leaves(res.delta))
+            dev_norms = jnp.sqrt(sq) * contrib
+            # Per-home-class weighted delta sums: the cohort-attribution
+            # numerators (num_classes is static — one extra signature).
+            class_w = jax.ops.segment_sum(weights, classes, num_classes)
+            class_wsum = jax.tree.map(
+                lambda leaf: jax.ops.segment_sum(
+                    leaf * weights.reshape((-1,) + (1,) * (leaf.ndim - 1)),
+                    classes, num_classes),
+                res.delta)
+            return ((wsum, total_w, loss_sum, n_comp),
+                    dev_norms, (class_wsum, class_w))
+
+        return jax.jit(obs_chunk_fn)
 
     def _build_finish_fn(self):
         """The engine's round epilogue (fed/programs.finish_round, plain
@@ -364,7 +419,10 @@ class FleetSim:
                 "completed": n_comp,
                 "total_weight": total_w,
             }
-            return new_state, metrics
+            # mean_delta rides along for the convergence observatory —
+            # already materialized, so exposing it costs nothing on the
+            # default plane (it is simply never fetched).
+            return new_state, mean_delta, metrics
 
         return jax.jit(finish)
 
@@ -459,6 +517,13 @@ class FleetSim:
             params = self.server_state.params
             acc = self._zero_acc()
             r_dev = jnp.asarray(r, jnp.int32)
+            observing = self._learn is not None
+            if observing:
+                cls_pad = np.zeros(padded, np.int32)
+                if self._population is not None:
+                    cls_pad[:n] = self._population.home_classes(ids)
+                dev_norm_parts: list = []
+                class_acc = None
             with self.tracer.span("train_chunks", round=r, cohort=n,
                                   chunks=padded // chunk):
                 if n:
@@ -471,16 +536,32 @@ class FleetSim:
                                               chunk=lo // chunk):
                             sl = slice(lo, lo + chunk)
                             cx, cy, cc = self._shard_fn(ids_pad[sl])
-                            part = self._chunk_fn(
-                                self.base_key, params, cx, cy, cc,
-                                ids_pad[sl], r_dev, bud_pad[sl],
-                                keep_pad[sl])
+                            if observing:
+                                part, dn, cpart = self._obs_program()(
+                                    self.base_key, params, cx, cy, cc,
+                                    ids_pad[sl], r_dev, bud_pad[sl],
+                                    keep_pad[sl], cls_pad[sl])
+                                dev_norm_parts.append(dn)
+                                class_acc = (cpart if class_acc is None
+                                             else jax.tree.map(
+                                                 jnp.add, class_acc, cpart))
+                            else:
+                                part = self._chunk_fn(
+                                    self.base_key, params, cx, cy, cc,
+                                    ids_pad[sl], r_dev, bud_pad[sl],
+                                    keep_pad[sl])
                             acc = self._fold_fn(acc, part)
-            with self.tracer.span("server_update", round=r):
-                self.server_state, metrics = self._finish_fn(
+            with self.tracer.span("server_update", round=r) as up_sp:
+                self.server_state, mean_delta, metrics = self._finish_fn(
                     self.server_state, *acc)
                 out = {k: float(v)
                        for k, v in jax.device_get(metrics).items()}
+                conv_sig = None
+                if observing:
+                    conv_sig = self._learn_round_feed(
+                        r, ids, mean_delta, up_sp,
+                        dev_norm_parts if n else [],
+                        class_acc)
 
         n_trained = int(trains.sum())
         n_reporting = int(uplink.sum())
@@ -495,6 +576,10 @@ class FleetSim:
             bytes_up_est=bytes_up,
             **fstats,
         )
+        if conv_sig:
+            # conv_* learning-health keys only under --learn-observe —
+            # default round records stay byte-identical (pinned by test).
+            out.update(conv_sig)
         if self.gather_avoided_bytes:
             # Key present only under a sharded server (tp_size > 1), so
             # default round records stay byte-identical.  One broadcast
@@ -527,16 +612,73 @@ class FleetSim:
         self.history.append(out)
         return out
 
+    def _obs_program(self):
+        """Lazily-built observatory chunk program: it needs the
+        population's ``num_classes`` (from_learner planes lack one and
+        fall back to a single bucket), and building it only on first use
+        keeps the default plane's program set untouched."""
+        if self._obs_chunk_fn is None:
+            ncls = (self._population.spec.num_classes
+                    if self._population is not None else 1)
+            self._obs_chunk_fn = telemetry.CompileTracker(
+                self._build_chunk_fn(observe=True, num_classes=ncls),
+                name="fleetsim.obs_chunk")
+        return self._obs_chunk_fn
+
+    def _learn_round_feed(self, r: int, ids: np.ndarray, mean_delta,
+                          span, dev_norm_parts: list, class_acc):
+        """Fold the round's learning signals: aggregate norm/cos/trend
+        from the observatory, per-device skew (anomalous norms feed the
+        health ledger — a diverging device is a health event, same as a
+        straggler), per-cohort drift attribution, span attrs, and the
+        learn.* metric export.  Returns the record's conv_* dict."""
+        from colearn_federated_learning_tpu.telemetry import convergence
+
+        sig = self._learn.observe(mean_delta,
+                                  lr=self.config.fed.server_lr)
+        if sig is None:
+            return None
+        n = ids.shape[0]
+        if dev_norm_parts:
+            norms = np.concatenate(
+                [np.asarray(p) for p in dev_norm_parts])[:n]
+            contributors = norms > 0.0
+            if contributors.any():
+                sk = convergence.device_skew(norms[contributors])
+                sig["conv_norm_median"] = round(sk["median"], 8)
+                sig["conv_norm_p90"] = round(sk["p90"], 8)
+                sig["conv_norm_anomalies"] = len(sk["anomalies"])
+                if self.health is not None and sk["anomalies"]:
+                    cids = ids[contributors]
+                    for idx in sk["anomalies"]:
+                        self.health.record(str(int(cids[idx])), round=r,
+                                           norm_anomaly=1)
+        if class_acc is not None and self._population is not None:
+            class_wsum, class_w = class_acc
+            sig.update(convergence.cohort_skew(
+                class_wsum, np.asarray(class_w), mean_delta))
+        span.attrs["conv_update_norm"] = sig["conv_update_norm"]
+        span.attrs["conv_trend"] = sig["conv_trend"]
+        if "conv_cos_prev" in sig:
+            span.attrs["conv_cos_prev"] = sig["conv_cos_prev"]
+        self._learn.export_metrics(telemetry.get_registry(), sig)
+        return sig
+
     @property
     def compile_counts(self) -> dict:
         """Distinct XLA signatures per jitted program.  The chunked-vmap
         invariant — zero-padding makes every chunk the same shape — holds
         exactly when ``chunk`` stays at 1 across a whole sweep."""
-        return {
+        out = {
             "chunk": self._chunk_fn.compiles,
             "finish": self._finish_fn.compiles,
             "fold": self._fold_fn.compiles,
         }
+        if self._obs_chunk_fn is not None:
+            # Observatory program, present only under --learn-observe —
+            # the default trio above is contract-pinned.
+            out["obs_chunk"] = self._obs_chunk_fn.compiles
+        return out
 
     def fit(self, rounds: int, log_fn=None) -> list[dict]:
         for _ in range(rounds):
@@ -790,9 +932,15 @@ class FleetSim:
                 part = (pytrees.tree_scale(wsum, s_w), total_w * s_w,
                         loss_sum * s_w, n_comp)
                 acc = self._fold_fn(acc, part)
-            self.server_state, metrics = self._finish_fn(
+            self.server_state, mean_delta, metrics = self._finish_fn(
                 self.server_state, *acc)
             out = {k: float(v) for k, v in jax.device_get(metrics).items()}
+            conv_sig = None
+            if self._learn is not None:
+                conv_sig = self._learn.observe(
+                    mean_delta, lr=self.config.fed.server_lr)
+                if conv_sig:
+                    self._learn.export_metrics(reg, conv_sig)
             version += 1
             ring[version] = self.server_state.params
             for v in [v for v in ring if v < version - max_staleness]:
@@ -837,6 +985,9 @@ class FleetSim:
                 # feature off.
                 rec["pruned"] = len(pruned)
                 rec["pruned_total"] = pruned_total
+            if conv_sig:
+                # conv_* learning-health keys only under --learn-observe.
+                rec.update(conv_sig)
             reg.counter("fleetsim.async_aggregations_total").inc()
             self.history.append(rec)
             if log_fn is not None:

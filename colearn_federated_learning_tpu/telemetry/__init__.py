@@ -18,7 +18,10 @@ Public surface:
 - :mod:`.health` — durable per-device health ledger (straggler
   attribution, latency sketches, ``colearn health`` renderer);
 - :mod:`.arrival` — seeded-EWMA arrival-rate estimation (fleet +
-  per-device) feeding the async observatory and ``--async-buffer auto``.
+  per-device) feeding the async observatory and ``--async-buffer auto``;
+- :mod:`.convergence` — the learning-health plane: per-round update-norm
+  / cosine / trend signals from the aggregate, per-cohort drift
+  attribution, and the ``colearn converge`` report.
 """
 
 from colearn_federated_learning_tpu.telemetry.tracer import (  # noqa: F401
@@ -66,6 +69,12 @@ from colearn_federated_learning_tpu.telemetry.health import (  # noqa: F401
 )
 from colearn_federated_learning_tpu.telemetry.arrival import (  # noqa: F401
     ArrivalEstimator,
+)
+from colearn_federated_learning_tpu.telemetry.convergence import (  # noqa: F401,E501
+    ConvergenceObservatory,
+    cohort_skew,
+    device_skew,
+    render_convergence_report,
 )
 from colearn_federated_learning_tpu.telemetry.flight import (  # noqa: F401
     FlightRecorder,

@@ -1,0 +1,264 @@
+"""Convergence observatory: learning-health signals from the aggregate.
+
+Every prior observability plane (spans, metrics, flight recorder, health
+ledger) watches the *machinery*; this one watches the *model*.  Per round
+it derives, from the already-materialized mean update — pure pytree math,
+jit-safe, zero extra communication:
+
+- global update norm and the effective server step it induces
+  (``server_lr * ||delta||``);
+- cosine similarity to the previous round's update (progress points the
+  same way round over round; oscillation flips sign);
+- an EWMA'd update-norm trend classified into ``warmup`` / ``progress``
+  / ``plateau`` / ``oscillation`` / ``divergence``.
+
+The same constraint secure aggregation imposes (Bonawitz et al.: the
+server only ever opens the aggregate) shapes the API: everything above
+needs ONLY the aggregate.  Per-device/per-cohort skew attribution
+(:func:`device_skew`, :func:`cohort_skew`) is reserved for planes where
+individual updates are legitimately visible — secure_agg off, or fleetsim
+where updates are simulation-local.
+
+All tree math goes through ``jax.tree`` leaves, so LoRA factor trees
+(``{path: {"lora_a": A, "lora_b": B}}``) fold natively, exactly like the
+StreamingFolder does — no densify, no special-casing.
+
+Feature-gated everywhere: ``--learn-observe`` stamps ``conv_*`` record
+keys and ``learn.*`` metrics; default round records stay byte-identical
+(pinned by tests on the sync, async, and fleetsim planes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Iterable, Optional
+
+TREND_WARMUP = "warmup"
+TREND_PROGRESS = "progress"
+TREND_PLATEAU = "plateau"
+TREND_OSCILLATION = "oscillation"
+TREND_DIVERGENCE = "divergence"
+TRENDS = (TREND_WARMUP, TREND_PROGRESS, TREND_PLATEAU,
+          TREND_OSCILLATION, TREND_DIVERGENCE)
+
+
+# ------------------------------------------------------------- tree math --
+def tree_norm(tree) -> float:
+    """Global L2 norm over every leaf (dense pytrees and LoRA factor
+    trees alike).  Host float — call once per round, never per step."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return 0.0
+    return float(jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves)))
+
+
+def tree_cosine(a, b) -> Optional[float]:
+    """Cosine similarity between two pytrees with identical structure;
+    ``None`` (undefined, NOT NaN) when either side has zero norm."""
+    import jax
+    import jax.numpy as jnp
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    dot = float(sum(jnp.vdot(x, y).real for x, y in zip(la, lb))) \
+        if la else 0.0
+    na, nb = tree_norm(a), tree_norm(b)
+    if na <= 0.0 or nb <= 0.0:
+        return None
+    return max(-1.0, min(1.0, dot / (na * nb)))
+
+
+# ---------------------------------------------------------- observatory --
+@dataclasses.dataclass
+class ConvergenceObservatory:
+    """Stateful per-plane learning-health tracker.
+
+    ``observe(mean_delta, lr=...)`` returns the round's ``conv_*``
+    signal dict (record-ready scalars/strings) or ``None`` for a no-op
+    round (quorum skip / unmask failure): state is untouched, so the
+    trend picks up where it left off.
+    """
+
+    ewma_alpha: float = 0.3          # update-norm EWMA smoothing
+    divergence_ratio: float = 2.0    # norm > ratio * ewma -> divergence
+    plateau_band: float = 0.1        # |norm/ewma - 1| <= band -> plateau
+    oscillation_cos: float = -0.2    # cos(prev) below this -> oscillation
+    warmup_rounds: int = 2           # observations before classifying
+    keep_prev: bool = True           # retain prev update for cosine
+
+    _prev_update: Any = dataclasses.field(default=None, repr=False)
+    _ewma: Optional[float] = None
+    _seen: int = 0
+
+    def observe(self, mean_delta, *, lr: float = 1.0) -> Optional[dict]:
+        if mean_delta is None:
+            return None
+        norm = tree_norm(mean_delta)
+        if not math.isfinite(norm):
+            # A non-finite aggregate is the strongest divergence signal
+            # there is; classify it directly rather than poisoning the
+            # EWMA with inf/NaN.
+            self._seen += 1
+            self._prev_update = None
+            return {"conv_update_norm": norm,
+                    "conv_step_size": norm * float(lr),
+                    "conv_norm_ewma": float(self._ewma or 0.0),
+                    "conv_trend": TREND_DIVERGENCE}
+        cos = (tree_cosine(mean_delta, self._prev_update)
+               if self._prev_update is not None else None)
+        trend = self._classify(norm, cos)
+        prev_ewma = self._ewma
+        self._ewma = (norm if prev_ewma is None
+                      else self.ewma_alpha * norm
+                      + (1.0 - self.ewma_alpha) * prev_ewma)
+        self._seen += 1
+        if self.keep_prev:
+            self._prev_update = mean_delta
+        sig = {
+            "conv_update_norm": round(norm, 8),
+            "conv_step_size": round(norm * float(lr), 8),
+            "conv_norm_ewma": round(self._ewma, 8),
+            "conv_trend": trend,
+        }
+        if cos is not None:
+            # Key only present once a previous update exists AND both
+            # norms are nonzero — first round stays cosine-free by
+            # construction (undefined, not NaN).
+            sig["conv_cos_prev"] = round(cos, 6)
+        return sig
+
+    def _classify(self, norm: float, cos: Optional[float]) -> str:
+        if self._seen < self.warmup_rounds or self._ewma is None:
+            return TREND_WARMUP
+        if norm > self.divergence_ratio * max(self._ewma, 1e-30):
+            return TREND_DIVERGENCE
+        if cos is not None and cos < self.oscillation_cos:
+            return TREND_OSCILLATION
+        if abs(norm / max(self._ewma, 1e-30) - 1.0) <= self.plateau_band:
+            return TREND_PLATEAU
+        return TREND_PROGRESS
+
+    # -- metric export (learn.* — declared in analysis/metric_catalog.py)
+    def export_metrics(self, reg, sig: dict) -> None:
+        reg.gauge("learn.update_norm").set(sig["conv_update_norm"])
+        reg.gauge("learn.update_norm_ewma").set(sig["conv_norm_ewma"])
+        reg.gauge("learn.step_size").set(sig["conv_step_size"])
+        if "conv_cos_prev" in sig:
+            reg.gauge("learn.cos_prev").set(sig["conv_cos_prev"])
+        reg.histogram("learn.update_norm_dist").observe(
+            sig["conv_update_norm"])
+        reg.counter(
+            f"learn.trend_total{{trend={sig['conv_trend']}}}").inc()
+        if "conv_cohort_skew" in sig:
+            reg.gauge("learn.cohort_skew").set(sig["conv_cohort_skew"])
+
+
+# ------------------------------------------------- per-device attribution --
+def device_skew(norms: Iterable[float], *,
+                anomaly_ratio: float = 3.0) -> dict:
+    """Summarize per-device update norms: median, p90, and the indices of
+    anomalously-large updates (norm > ``anomaly_ratio`` x median — a
+    poisoned or diverging device is a health event, same as a straggler).
+
+    Only meaningful where individual updates are visible (secure_agg off,
+    or fleetsim).  Returns ``{"median": ..., "p90": ..., "anomalies":
+    [idx, ...]}``; empty input -> zeros and no anomalies.
+    """
+    xs = sorted(float(n) for n in norms)
+    if not xs:
+        return {"median": 0.0, "p90": 0.0, "anomalies": []}
+    def q(p):
+        i = min(len(xs) - 1, max(0, int(round(p * (len(xs) - 1)))))
+        return xs[i]
+    med = q(0.5)
+    thresh = anomaly_ratio * max(med, 1e-30)
+    anomalies = [i for i, n in enumerate(float(n) for n in norms)
+                 if n > thresh]
+    return {"median": med, "p90": q(0.9), "anomalies": anomalies}
+
+
+def cohort_skew(class_sums, class_weights, aggregate) -> dict:
+    """Attribute drift to cohorts: cosine of each cohort's weighted-mean
+    update (centroid) to the global aggregate.
+
+    ``class_sums`` is a pytree whose leaves carry a leading cohort axis
+    (per-cohort weighted delta sums); ``class_weights`` the matching
+    ``(num_cohorts,)`` weight vector.  Skew is ``1 - min_cos`` over
+    populated cohorts — 0 when every cohort pushes the same way (IID),
+    approaching/exceeding 1 as a seeded non-IID cluster pulls against
+    the aggregate.  Returns record-ready ``conv_cohort_*`` floats.
+    """
+    import jax
+    import numpy as np
+
+    w = np.asarray(class_weights, dtype=np.float64)
+    coses = []
+    for c in range(w.shape[0]):
+        if w[c] <= 0.0:
+            continue
+        centroid = jax.tree.map(lambda x: x[c] / w[c], class_sums)
+        cos = tree_cosine(centroid, aggregate)
+        if cos is not None:
+            coses.append(cos)
+    if not coses:
+        return {"conv_cohort_skew": 0.0, "conv_cohort_cos_min": 1.0}
+    return {"conv_cohort_skew": round(1.0 - min(coses), 6),
+            "conv_cohort_cos_min": round(min(coses), 6)}
+
+
+# ------------------------------------------------------------- reporting --
+def convergence_records(records: Iterable[dict]) -> list:
+    """The sub-sequence of round records carrying learning signals,
+    ordered by round when a round key is present."""
+    out = [r for r in records if "conv_update_norm" in r]
+    key = "round" if all("round" in r for r in out) else None
+    if key:
+        out.sort(key=lambda r: r[key])
+    return out
+
+
+def render_convergence_report(records: Iterable[dict]) -> str:
+    """Round-over-round learning report for ``colearn converge`` from any
+    committed JSONL (results dirs, event streams): per-round norm / step
+    / EWMA / cosine / trend, then a trend census and the first round each
+    non-progress trend appeared."""
+    recs = convergence_records(records)
+    if not recs:
+        return ("no learning signals found "
+                "(run with --learn-observe to stamp conv_* keys)")
+    lines = ["round  update_norm     step_size       ewma        "
+             "cos_prev  trend"]
+    for r in recs:
+        cos = r.get("conv_cos_prev")
+        lines.append(
+            "%5s  %-14.6g  %-14.6g  %-10.5g  %-8s  %s" % (
+                r.get("round", "-"),
+                r["conv_update_norm"],
+                r.get("conv_step_size", float("nan")),
+                r.get("conv_norm_ewma", float("nan")),
+                ("%.4f" % cos) if cos is not None else "-",
+                r.get("conv_trend", "-")))
+    census: dict = {}
+    first: dict = {}
+    for r in recs:
+        t = r.get("conv_trend", "-")
+        census[t] = census.get(t, 0) + 1
+        first.setdefault(t, r.get("round", "-"))
+    lines.append("")
+    lines.append("trends: " + "  ".join(
+        f"{t}={census[t]}" for t in TRENDS if t in census))
+    for t in (TREND_DIVERGENCE, TREND_OSCILLATION, TREND_PLATEAU):
+        if t in first:
+            lines.append(f"first {t}: round {first[t]}")
+    norms = [r["conv_update_norm"] for r in recs]
+    lines.append("update_norm: first=%.6g last=%.6g max=%.6g" % (
+        norms[0], norms[-1], max(norms)))
+    if any("conv_cohort_skew" in r for r in recs):
+        skews = [r["conv_cohort_skew"] for r in recs
+                 if "conv_cohort_skew" in r]
+        lines.append("cohort_skew: mean=%.4f max=%.4f" % (
+            sum(skews) / len(skews), max(skews)))
+    return "\n".join(lines)

@@ -244,6 +244,24 @@ def summarize_trace(doc: dict, root: str = "round") -> str:
                     f"p90 {_q(0.90):.0f}   p99 {_q(0.99):.0f}   "
                     f"mean buffer wait "
                     f"{sum(waits) / len(waits) * 1e3:.1f} ms")
+    # Convergence observatory: aggregate/apply/server_update spans carry
+    # conv_* attrs only when the run folded updates under --learn-observe.
+    conv = [sp for spans in by_name.values() for sp in spans
+            if sp.attrs.get("conv_update_norm") is not None]
+    if conv:
+        conv.sort(key=lambda sp: sp.t_wall)
+        norms = [float(sp.attrs["conv_update_norm"]) for sp in conv]
+        trends = [str(sp.attrs.get("conv_trend") or "") for sp in conv]
+        census: dict[str, int] = {}
+        for t in trends:
+            if t:
+                census[t] = census.get(t, 0) + 1
+        census_s = " ".join(f"{k}={census[k]}" for k in sorted(census))
+        lines.append("")
+        lines.append(
+            f"learning: {len(conv)} observed fold(s), update norm "
+            f"{norms[0]:.3e} -> {norms[-1]:.3e} (max {max(norms):.3e})"
+            + (f"; trend {census_s}" if census_s else ""))
     metrics = doc.get("otherData", {}).get("metrics")
     if metrics:
         lines.append("")

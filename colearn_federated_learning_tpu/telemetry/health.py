@@ -33,8 +33,13 @@ from colearn_federated_learning_tpu.telemetry import registry as _metrics
 # ``prune`` / ``pump_stall`` are the async-plane feeds (a paused pump and
 # a dispatch that burned most of its timeout budget, per device) — old
 # ledgers without them load as zeros via ``from_dict``'s defaults.
+# ``norm_anomaly`` is the convergence-observatory feed (an update whose
+# norm towers over the cohort median — a poisoned or diverging device is
+# a health event, same as a straggler); it rides the same
+# forward-compatible zero-default path and is deliberately NOT a rendered
+# column (`colearn health` output is contract-stable).
 COUNT_FIELDS = ("deadline_miss", "retry", "corrupt_frame", "eviction",
-                "secure_dropout", "prune", "pump_stall")
+                "secure_dropout", "prune", "pump_stall", "norm_anomaly")
 
 _EWMA_ALPHA = 0.2
 _MAX_SAMPLES = 256
@@ -101,7 +106,7 @@ class DeviceHealth:
         near-miss of the dispatch timeout."""
         c = self.counts
         return (5.0 * c["eviction"] + 3.0 * c["deadline_miss"]
-                + 3.0 * c["prune"]
+                + 3.0 * c["prune"] + 3.0 * c["norm_anomaly"]
                 + 2.0 * c["corrupt_frame"] + 2.0 * c["secure_dropout"]
                 + 1.0 * c["retry"] + 1.0 * c["pump_stall"])
 

@@ -517,6 +517,33 @@ def render_top(snapshot: dict, prev: Optional[dict] = None,
             if snapshot.get(f"async.pumps{{state={st}}}") is not None]
         if pump_rows:
             lines.append("  pumps            " + "   ".join(pump_rows))
+    # Learning plane (the convergence observatory): shown only when a
+    # --learn-observe run exported learn.* gauges; default snapshots
+    # keep the classic layout.
+    upd_norm = snapshot.get("learn.update_norm")
+    if upd_norm is not None and not isinstance(upd_norm, dict):
+        lines.append("")
+        lines.append("learning")
+        lines.append(f"  update norm      {float(upd_norm):>12.6f}")
+        ewma = val("learn.update_norm_ewma")
+        if ewma:
+            lines.append(f"  norm ewma        {ewma:>12.6f}")
+        step = val("learn.step_size")
+        if step:
+            lines.append(f"  step size        {step:>12.6f}")
+        cos = snapshot.get("learn.cos_prev")
+        if cos is not None and not isinstance(cos, dict):
+            lines.append(f"  cos(prev update) {float(cos):>12.4f}")
+        skew = snapshot.get("learn.cohort_skew")
+        if skew is not None and not isinstance(skew, dict):
+            lines.append(f"  cohort skew      {float(skew):>12.4f}")
+        trend_rows = [
+            f"{t} {val(f'learn.trend_total{{trend={t}}}'):.0f}"
+            for t in ("warmup", "progress", "plateau", "oscillation",
+                      "divergence")
+            if snapshot.get(f"learn.trend_total{{trend={t}}}") is not None]
+        if trend_rows:
+            lines.append("  trends           " + "   ".join(trend_rows))
     compiles = val("telemetry.compile_total")
     recompiles = val("telemetry.recompile_total")
     if compiles or recompiles:

@@ -64,6 +64,13 @@ def validate_robustness(config: "ExperimentConfig") -> None:
             f"comm_retries must be >= 0, got {run.comm_retries}")
     if run.comm_backoff_base < 0 or run.comm_backoff_max < 0:
         raise ValueError("comm backoff values must be >= 0")
+    if fed.lr_spike_round < -1:
+        raise ValueError(
+            f"lr_spike_round must be >= -1, got {fed.lr_spike_round}")
+    if fed.lr_spike_multiplier <= 0:
+        raise ValueError(
+            "lr_spike_multiplier must be positive, got "
+            f"{fed.lr_spike_multiplier}")
     if run.worker_enroll_timeout <= 0:
         raise ValueError(
             "worker_enroll_timeout must be positive, got "
@@ -298,6 +305,14 @@ class FedConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_merge_every: int = 10
+    # Chaos knob for the convergence observatory's divergence gate
+    # (scripts/learn_smoke.py): multiply the client lr by
+    # ``lr_spike_multiplier`` for exactly round ``lr_spike_round``.
+    # The gate is config-static (fed/strategies.lr_scale_for_round), so
+    # default graphs — and round records — are byte-identical with the
+    # knob off.  -1 disables.
+    lr_spike_round: int = -1
+    lr_spike_multiplier: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -339,6 +354,11 @@ class RunConfig:
     # installed as the transport interposer; None = no fault layer at all.
     fault_plan: Optional[str] = None
     fault_seed: int = 0
+    # Convergence observatory (telemetry/convergence.py): stamp conv_*
+    # learning-health keys on round records and export learn.* metrics.
+    # Off by default — default round records stay byte-identical (pinned
+    # by tests on the sync, async, and fleetsim planes).
+    learn_observe: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -222,8 +222,30 @@ ASYNC_AUTOK_ROW_SCHEMA = {
     "bench_wall_s": float,
 }
 
+# Cohort-drift attribution rows (--drift-sweep): the convergence
+# observatory's per-cohort skew (1 - min cohort-centroid cosine vs the
+# round aggregate) measured on the SAME seeded fleet twice — once with
+# seeded non-IID label skew, once IID — so the committed row proves the
+# signal separates data heterogeneity from sampling noise.
+DRIFT_ROW_SCHEMA = {
+    "bench": str,
+    "devices": int,
+    "rounds": int,
+    "label_skew_noniid": float,
+    "label_skew_iid": float,
+    "cohort_skew_noniid_mean": float,
+    "cohort_skew_noniid_max": float,
+    "cohort_skew_iid_mean": float,
+    "cohort_skew_iid_max": float,
+    "skew_separation": float,
+    "update_norm_final_noniid": float,
+    "update_norm_final_iid": float,
+    "bench_wall_s": float,
+}
+
 SCHEMAS = {
     "fleet_round": ROW_SCHEMA,
+    "fleet_learn_drift": DRIFT_ROW_SCHEMA,
     "fleet_mask_cost": MASK_ROW_SCHEMA,
     "fleet_uplink_bytes": UPLINK_ROW_SCHEMA,
     "fleet_ingest_scaling": INGEST_ROW_SCHEMA,
@@ -716,6 +738,71 @@ def async_autok_point(*, devices: int = 64, aggregations: int = 120,
     }
 
 
+def drift_point(*, devices: int = 64, rounds: int = 10,
+                label_skew_noniid: float = 0.9,
+                label_skew_iid: float = 0.0, seed: int = 0) -> dict:
+    """One MEASURED cohort-drift attribution row: two --learn-observe
+    fleetsim runs at matched seeds, differing ONLY in the population's
+    label skew.  conv_cohort_skew (telemetry/convergence.cohort_skew)
+    must separate the seeded non-IID fleet from the IID one — the
+    acceptance evidence that the skew signal attributes drift to data
+    heterogeneity rather than sampling noise.  Warmup rounds are
+    excluded from the means (the first folds are dominated by init
+    transients on both fleets)."""
+    from colearn_federated_learning_tpu import fleetsim
+    from colearn_federated_learning_tpu.utils.config import (
+        ExperimentConfig, FedConfig, ModelConfig, RunConfig)
+
+    t0 = time.time()
+
+    def run(label_skew: float) -> list:
+        spec = fleetsim.PopulationSpec(
+            num_devices=devices, num_classes=10, feature_dim=32,
+            shard_capacity=16, label_skew=label_skew, seed=seed)
+        population = fleetsim.DevicePopulation(spec)
+        traffic = fleetsim.TrafficModel(
+            fleetsim.TrafficSpec(base_rate=2000.0, diurnal_amplitude=0.0,
+                                 seed=seed),
+            spec.num_devices)
+        config = ExperimentConfig(
+            model=ModelConfig(name="mlp", num_classes=10, hidden_dim=64,
+                              depth=2),
+            fed=FedConfig(strategy="fedavg", local_steps=2,
+                          batch_size=16, lr=0.05),
+            run=RunConfig(name="bench-learn-drift", seed=seed,
+                          learn_observe=True))
+        sim = fleetsim.FleetSim.from_population(
+            config, population, traffic, cohort_size=32, chunk_size=32)
+        return sim.fit(rounds)
+
+    def skew_stats(history) -> tuple:
+        vals = [r["conv_cohort_skew"] for r in history[2:]
+                if "conv_cohort_skew" in r]
+        assert vals, "no conv_cohort_skew in observed round records"
+        return (sum(vals) / len(vals), max(vals))
+
+    noniid = run(label_skew_noniid)
+    iid = run(label_skew_iid)
+    nm, nx = skew_stats(noniid)
+    im, ix = skew_stats(iid)
+    return {
+        "bench": "fleet_learn_drift",
+        "devices": devices,
+        "rounds": rounds,
+        "label_skew_noniid": label_skew_noniid,
+        "label_skew_iid": label_skew_iid,
+        "cohort_skew_noniid_mean": round(nm, 4),
+        "cohort_skew_noniid_max": round(nx, 4),
+        "cohort_skew_iid_mean": round(im, 4),
+        "cohort_skew_iid_max": round(ix, 4),
+        "skew_separation": round(nm - im, 4),
+        "update_norm_final_noniid": round(
+            noniid[-1]["conv_update_norm"], 5),
+        "update_norm_final_iid": round(iid[-1]["conv_update_norm"], 5),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
 def check_schema(path: str) -> int:
     """Validate every row of a bench JSONL against the schema for its
     ``bench`` tag (CI gate)."""
@@ -807,6 +894,11 @@ def main(argv=None) -> int:
     ap.add_argument("--async-devices", default="1000,10000,100000,1000000",
                     help="comma-separated fleet sizes for the async "
                          "throughput sweep")
+    ap.add_argument("--drift-sweep", action="store_true",
+                    help="append ONE measured fleet_learn_drift row: "
+                         "conv_cohort_skew on the same seeded fleet with "
+                         "non-IID (label_skew 0.9) vs IID (0.0) "
+                         "populations under --learn-observe")
     ap.add_argument("--append", action="store_true",
                     help="append rows to --out instead of rewriting it "
                          "(e.g. --cohorts '' --mask-sweep --append adds "
@@ -849,6 +941,11 @@ def main(argv=None) -> int:
         rows.append(row)
         print(json.dumps(row))
         row = async_autok_point(seed=args.seed)
+        rows.append(row)
+        print(json.dumps(row))
+
+    if args.drift_sweep:
+        row = drift_point(seed=args.seed)
         rows.append(row)
         print(json.dumps(row))
 

@@ -1,0 +1,158 @@
+"""Learning-divergence sentinel smoke: the convergence-observatory
+chaos gate.
+
+Two same-seed 12-round fleetsim runs under ``--learn-observe``, each
+writing its round records as a ``results/learn_events.jsonl`` stream
+into a throwaway root (with the repo's pyproject.toml copied in so
+``analysis.sentinel.load_rules`` finds the rule set):
+
+1. clean — every ``live-learn-*`` sentinel must pass;
+2. chaos — a one-shot 10x client-lr spike injected at round 9
+   (``fed.lr_spike_round`` / ``fed.lr_spike_multiplier``, the
+   config-static overlay in fed/strategies.lr_scale_for_round) must trip
+   ``live-learn-divergence`` — and trip it WITHIN 3 rounds of the
+   injection: the verdict is evaluated on rows truncated at round
+   ``spike + 2``, so detection cannot lean on post-window history.
+
+Exits non-zero on any violation; importable (``main()``) so the test
+suite can run it in-process without a subprocess jax re-init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROUNDS = 12
+SPIKE_ROUND = 9
+SPIKE_MULTIPLIER = 10.0
+DETECT_WITHIN = 3          # rounds from injection to a red verdict
+
+
+def _jsonable(obj):
+    if hasattr(obj, "item") and not isinstance(obj, (list, dict)):
+        return obj.item()          # numpy scalar
+    if hasattr(obj, "tolist"):
+        return obj.tolist()        # numpy array
+    raise TypeError(f"not JSON-serializable: {type(obj)!r}")
+
+
+def _build_fleet(seed: int, **fed_kw):
+    from colearn_federated_learning_tpu import fleetsim
+    from colearn_federated_learning_tpu.utils.config import (
+        ExperimentConfig,
+        FedConfig,
+        ModelConfig,
+        RunConfig,
+    )
+
+    fed = dict(strategy="fedavg", local_steps=2, batch_size=8, lr=0.05,
+               momentum=0.0)
+    fed.update(fed_kw)
+    cfg = ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32,
+                          depth=1),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="learn_smoke", seed=seed, learn_observe=True),
+    )
+    spec = fleetsim.PopulationSpec(num_devices=64, feature_dim=16,
+                                   shard_capacity=16, min_examples=4,
+                                   seed=seed)
+    population = fleetsim.DevicePopulation(spec)
+    traffic = fleetsim.TrafficModel(
+        fleetsim.TrafficSpec(base_rate=2000.0, diurnal_amplitude=0.0,
+                             seed=seed),
+        spec.num_devices)
+    return fleetsim.FleetSim.from_population(
+        cfg, population, traffic, cohort_size=16, chunk_size=16)
+
+
+def _run(label: str, seed: int = 0, **fed_kw) -> tuple[str, list]:
+    """One observed fleetsim run → (sentinel root, round records)."""
+    root = tempfile.mkdtemp(prefix=f"colearn_learn_smoke_{label}_")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shutil.copy(os.path.join(repo, "pyproject.toml"),
+                os.path.join(root, "pyproject.toml"))
+    os.makedirs(os.path.join(root, "results"))
+    fleet = _build_fleet(seed, **fed_kw)
+    recs = fleet.fit(ROUNDS)
+    assert len(recs) == ROUNDS
+    for rec in recs:
+        assert "conv_update_norm" in rec, (
+            f"{label}: --learn-observe round record lost its conv_* keys: "
+            f"{sorted(rec)}")
+    _write_events(root, recs)
+    return root, recs
+
+
+def _write_events(root: str, recs: list) -> None:
+    path = os.path.join(root, "results", "learn_events.jsonl")
+    with open(path, "w") as f:
+        for rec in recs:
+            f.write(json.dumps({"event": "round", **rec},
+                               default=_jsonable) + "\n")
+
+
+def _learn_verdict(root: str) -> dict:
+    """Evaluate ONLY the live learning sentinels — the rules that read
+    the run's own event stream (the other rules, including
+    fleet-learn-drift-separation, gate committed bench files this
+    throwaway root does not carry)."""
+    from colearn_federated_learning_tpu.analysis import sentinel
+
+    rules = [r for r in sentinel.load_rules(root)
+             if "learn_events" in r.file]
+    assert len(rules) >= 3, [r.id for r in rules]
+    return sentinel.evaluate_slo(root, rules)
+
+
+def main() -> dict:
+    # ---- clean run: every learning sentinel green -----------------------
+    clean_root, clean_recs = _run("clean")
+    clean = _learn_verdict(clean_root)
+    assert clean["ok"], (
+        "clean run tripped a learning sentinel: "
+        f"{[r for r in clean['results'] if not r['ok']]}")
+
+    # ---- chaos run: same seed, one-shot 10x lr spike at round 9 ---------
+    spike_root, spike_recs = _run(
+        "spike", lr_spike_round=SPIKE_ROUND,
+        lr_spike_multiplier=SPIKE_MULTIPLIER)
+    # Pre-spike rounds are numerically identical to the clean run (the
+    # overlay is a jnp.where on the round index, same trace, same seed).
+    pre = round(clean_recs[SPIKE_ROUND - 1]["conv_update_norm"], 6)
+    pre_s = round(spike_recs[SPIKE_ROUND - 1]["conv_update_norm"], 6)
+    assert pre == pre_s, f"pre-spike drift: clean {pre} vs spiked {pre_s}"
+
+    # Detection deadline: the verdict must already be red with history
+    # truncated DETECT_WITHIN rounds after the injection.
+    cutoff = SPIKE_ROUND + DETECT_WITHIN       # rounds [0, cutoff)
+    _write_events(spike_root, spike_recs[:cutoff])
+    spiked = _learn_verdict(spike_root)
+    div = next(r for r in spiked["results"]
+               if r["id"] == "live-learn-divergence")
+    assert not div["ok"], (
+        f"10x lr spike at round {SPIKE_ROUND} did not trip "
+        f"live-learn-divergence by round {cutoff - 1}: {div}")
+    assert str(div["reason"]).startswith("above_max_ratio"), div
+
+    out = {
+        "clean_ok": clean["ok"],
+        "spike_tripped": not div["ok"],
+        "spike_ratio": div["value"],
+        "clean_norm_r8": pre,
+        "spike_norm_r9": spike_recs[SPIKE_ROUND]["conv_update_norm"],
+        "roots": [clean_root, spike_root],
+    }
+    return out
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    print(json.dumps(main(), indent=2, default=_jsonable))

@@ -44,10 +44,23 @@ async federation (broker + 3 workers + ``coordinate --async-buffer 2
   ``dispatch_train`` context, carries τ (``tau``) in its span args, and
   shares a trace with the worker-side ``worker.train`` span.
 
+A fourth **learning phase** runs the same federation under
+``--learn-observe`` (the convergence observatory) and asserts its
+end-to-end contract:
+
+- the mid-run scrape carries the ``learn_*`` instruments — the
+  update-norm gauge and the labeled trend census
+  (``colearn_learn_trend_total{trend=...}``) — under the same exposition
+  grammar;
+- the committed event stream carries the ``conv_*`` trail: one
+  ``conv_update_norm``/``conv_trend`` signal per round, with
+  ``conv_cos_prev`` absent on the first round (undefined) and present
+  on every later one.
+
 Exit 0 only if every check passes.  This is the CI ``obs-smoke`` job;
 the SLO sentinel gate (``colearn sentinel``) runs as its own CI step.
-Pass phase names (``classic``, ``tree``, ``async``) as argv to run a
-subset.
+Pass phase names (``classic``, ``tree``, ``async``, ``learning``) as
+argv to run a subset.
 """
 
 from __future__ import annotations
@@ -312,6 +325,93 @@ def run_async_phase(check, env: dict) -> None:
             p.wait()
 
 
+def run_learning_phase(check, env: dict) -> None:
+    """Convergence observatory over a REAL federation (--learn-observe):
+    the mid-run scrape carries the learn_* instruments and the committed
+    event stream carries the conv_* trail, one signal per round."""
+    workdir = tempfile.mkdtemp(prefix="colearn_obs_learn_")
+    events_path = os.path.join(workdir, "events.jsonl")
+    cfg = _config_flags()
+    procs: list[subprocess.Popen] = []
+
+    def spawn(args: list[str], **kw) -> subprocess.Popen:
+        p = subprocess.Popen([sys.executable, "-m", _CLI, *args],
+                             env=env, **kw)
+        procs.append(p)
+        return p
+
+    try:
+        broker = spawn(["broker"], stdout=subprocess.PIPE, text=True)
+        addr = json.loads(broker.stdout.readline())
+        host, port = addr["host"], str(addr["port"])
+        for i in range(N_WORKERS):
+            log = open(os.path.join(workdir, f"worker{i}.log"), "ab")
+            spawn(["worker", *cfg, "--client-id", str(i),
+                   "--broker-host", host, "--broker-port", port],
+                  stdout=log, stderr=log)
+        coord = spawn(
+            ["coordinate", *cfg, "--learn-observe",
+             "--metrics-port", "0", "--events-file", events_path,
+             "--broker-host", host, "--broker-port", port,
+             "--min-devices", str(N_WORKERS), "--round-timeout", "30",
+             "--enroll-timeout", "90", "--no-evaluator"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+
+        metrics_port = None
+        scraped = False
+        for line in coord.stderr:
+            try:
+                doc = json.loads(line.strip())
+            except json.JSONDecodeError:
+                continue
+            if doc.get("event") == "metrics_port":
+                metrics_port = int(doc["port"])
+            if "round" in doc and not scraped and metrics_port:
+                scraped = True
+                url = f"http://127.0.0.1:{metrics_port}/metrics"
+                text = urllib.request.urlopen(url, timeout=10) \
+                    .read().decode("utf-8")
+                lines = [ln for ln in text.splitlines() if ln]
+                bad = [ln for ln in lines if not _PROM_LINE.match(ln)]
+                check(not bad,
+                      f"learning scrape matches the exposition grammar "
+                      f"(bad: {bad[:3]})")
+                norm = [ln for ln in lines
+                        if ln.startswith("colearn_learn_update_norm ")]
+                check(bool(norm),
+                      "scrape carries the learn_update_norm gauge")
+                trend = [ln for ln in lines
+                         if ln.startswith("colearn_learn_trend_total{")
+                         and "trend=" in ln]
+                check(bool(trend),
+                      "scrape carries the labeled trend census "
+                      "(learn_trend_total{trend=...})")
+        rc = coord.wait(timeout=180)
+        check(rc == 0, f"learning coordinator exited 0 (got {rc})")
+
+        with open(events_path) as f:
+            events = [json.loads(ln) for ln in f if ln.strip()]
+        rounds = [e for e in events if e.get("event") == "round"]
+        check(len(rounds) >= ROUNDS,
+              f"event stream carries one event per round "
+              f"({len(rounds)}/{ROUNDS})")
+        trail = [e.get("conv_update_norm") for e in rounds]
+        check(all(isinstance(v, (int, float)) and v > 0 for v in trail),
+              f"every round event carries a conv_update_norm signal "
+              f"(trail: {trail})")
+        check(all("conv_trend" in e for e in rounds),
+              "every round event carries a conv_trend classification")
+        check(all("conv_cos_prev" in e for e in rounds[1:])
+              and "conv_cos_prev" not in rounds[0],
+              "conv_cos_prev absent on the first round, present after")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+
+
 def run_classic_phase(check, env: dict) -> None:
     """Flight recorder + exporter + event stream + SIGKILL dump +
     top/postmortem over one real federation (the original smoke)."""
@@ -465,6 +565,7 @@ _PHASES = {
     "classic": run_classic_phase,
     "tree": run_tree_phase,
     "async": run_async_phase,
+    "learning": run_learning_phase,
 }
 
 
@@ -476,7 +577,7 @@ def main(argv=None) -> int:
               f"choose from {sorted(_PHASES)}", file=sys.stderr)
         return 2
     if not names:
-        names = ["classic", "tree", "async"]
+        names = ["classic", "tree", "async", "learning"]
     env = dict(os.environ, PYTHONUNBUFFERED="1", JAX_PLATFORMS="cpu")
     failures: list[str] = []
 

@@ -658,8 +658,10 @@ def test_async_default_records_have_no_feature_keys():
                         "health_devices", "health_worst_device",
                         "mass_folded", "mass_discarded",
                         "arrival_rate_per_s", "staleness_p50",
-                        "staleness_p90", "staleness_p99"):
+                        "staleness_p90", "staleness_p99",
+                        "conv_update_norm", "conv_trend"):
                 assert key not in rec, key
+            assert not any(k.startswith("conv_") for k in rec)
         finally:
             for w in workers:
                 w.stop()

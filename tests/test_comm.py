@@ -102,6 +102,9 @@ def test_socket_federation_end_to_end():
             after = coord.evaluate()
             assert all(r["completed"] == 3 for r in hist)
             assert all(not r["dropped"] for r in hist)
+            # default records carry no feature-gated convergence keys
+            assert all(not any(k.startswith("conv_") for k in r)
+                       for r in hist)
             assert np.isfinite(hist[-1]["train_loss"])
             assert after["eval_acc"] >= before["eval_acc"]
             coord.close()

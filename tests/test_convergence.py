@@ -1,0 +1,484 @@
+"""Convergence observatory (telemetry/convergence.py) and its wiring.
+
+Unit coverage for the observatory's edge cases — first-round cosine
+(undefined, NOT NaN), no-op rounds, zero updates, LoRA factor-tree
+parity with dense trees, EWMA classification boundaries, non-finite
+aggregates — plus the per-device/per-cohort skew attribution, the
+``colearn converge`` report, the lr-spike chaos overlay
+(fed/strategies.lr_scale_for_round), and the conditional-record-key
+contract on all three planes: sync coordinator, async coordinator, and
+fleetsim records carry ``conv_*`` keys under ``--learn-observe`` and
+stay byte-identical without it.
+"""
+
+import json
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu import fleetsim, telemetry
+from colearn_federated_learning_tpu.fed import strategies
+from colearn_federated_learning_tpu.telemetry.convergence import (
+    ConvergenceObservatory,
+    cohort_skew,
+    device_skew,
+    render_convergence_report,
+    tree_cosine,
+    tree_norm,
+)
+from colearn_federated_learning_tpu.telemetry.registry import MetricsRegistry
+from colearn_federated_learning_tpu.utils.config import (
+    ExperimentConfig,
+    FedConfig,
+    ModelConfig,
+    RunConfig,
+    validate_robustness,
+)
+
+
+def _tree(*vals):
+    return {"layer": {"w": jnp.asarray(vals, jnp.float32)}}
+
+
+# ------------------------------------------------------------ tree math --
+def test_tree_norm_and_cosine_basics():
+    assert tree_norm({}) == 0.0
+    assert tree_norm(_tree(3.0, 4.0)) == pytest.approx(5.0)
+    assert tree_cosine(_tree(1.0, 0.0), _tree(2.0, 0.0)) == pytest.approx(1.0)
+    assert tree_cosine(_tree(1.0, 0.0), _tree(-1.0, 0.0)) == pytest.approx(
+        -1.0)
+    # Zero norm on either side: undefined -> None, never NaN.
+    assert tree_cosine(_tree(0.0, 0.0), _tree(1.0, 1.0)) is None
+    assert tree_cosine(_tree(1.0, 1.0), _tree(0.0, 0.0)) is None
+
+
+# ---------------------------------------------------- observatory edges --
+def test_first_round_has_no_cosine_and_classifies_warmup():
+    obs = ConvergenceObservatory()
+    sig = obs.observe(_tree(1.0, 2.0), lr=0.5)
+    assert sig["conv_trend"] == "warmup"
+    assert "conv_cos_prev" not in sig          # undefined, not NaN
+    assert sig["conv_update_norm"] == pytest.approx(math.sqrt(5.0))
+    assert sig["conv_step_size"] == pytest.approx(0.5 * math.sqrt(5.0))
+    # Second round: a previous update exists, the cosine appears.
+    sig2 = obs.observe(_tree(1.0, 2.0))
+    assert sig2["conv_cos_prev"] == pytest.approx(1.0)
+
+
+def test_none_delta_is_a_noop_round():
+    obs = ConvergenceObservatory()
+    obs.observe(_tree(1.0, 0.0))
+    assert obs.observe(None) is None
+    # State untouched: the trend picks up where it left off, and the
+    # cosine still compares against the last REAL update.
+    assert obs._seen == 1
+    sig = obs.observe(_tree(1.0, 0.0))
+    assert sig["conv_cos_prev"] == pytest.approx(1.0)
+
+
+def test_zero_update_round_yields_no_cosine_either_side():
+    obs = ConvergenceObservatory()
+    sig = obs.observe(_tree(0.0, 0.0))
+    assert sig["conv_update_norm"] == 0.0
+    assert "conv_cos_prev" not in sig
+    # The zero update became prev: next round's cosine is undefined too.
+    sig2 = obs.observe(_tree(1.0, 1.0))
+    assert "conv_cos_prev" not in sig2
+    assert sig2["conv_update_norm"] > 0
+
+
+def test_lora_factor_tree_parity_with_dense():
+    # Same numbers arranged as a dense layer vs a LoRA factor tree:
+    # every signal is identical — the observatory folds factor trees
+    # natively, no densify, no special-casing.
+    dense = ConvergenceObservatory()
+    lora = ConvergenceObservatory()
+    for step in (1.0, 0.5, 0.25):
+        d = {"layer": {"w": jnp.asarray([step, 2 * step], jnp.float32)}}
+        f = {"layer": {"lora_a": jnp.asarray([step], jnp.float32),
+                       "lora_b": jnp.asarray([2 * step], jnp.float32)}}
+        sd, sf = dense.observe(d), lora.observe(f)
+        assert sd == sf
+
+
+def test_ewma_classification_boundaries():
+    # warmup_rounds=0 so classification starts immediately after the
+    # first EWMA exists; alpha=1 pins the EWMA to the last norm, making
+    # every boundary exact.
+    obs = ConvergenceObservatory(ewma_alpha=1.0, warmup_rounds=0)
+    obs.observe(_tree(1.0, 0.0))                    # ewma = 1.0
+    # Exactly at the divergence ratio: NOT divergence (strict >)...
+    assert obs.observe(_tree(2.0, 0.0))["conv_trend"] == "progress"
+    obs2 = ConvergenceObservatory(ewma_alpha=1.0, warmup_rounds=0)
+    obs2.observe(_tree(1.0, 0.0))
+    # ...one epsilon above it: divergence.
+    assert obs2.observe(_tree(2.001, 0.0))["conv_trend"] == "divergence"
+    # Inside the plateau band (|ratio - 1| <= 0.1; the exact edge is
+    # not representable in float32, so probe clearly inside it).
+    obs3 = ConvergenceObservatory(ewma_alpha=1.0, warmup_rounds=0)
+    obs3.observe(_tree(1.0, 0.0))
+    assert obs3.observe(_tree(0.95, 0.0))["conv_trend"] == "plateau"
+    # Outside the band, below the divergence ratio: progress.
+    obs4 = ConvergenceObservatory(ewma_alpha=1.0, warmup_rounds=0)
+    obs4.observe(_tree(1.0, 0.0))
+    assert obs4.observe(_tree(0.5, 0.0))["conv_trend"] == "progress"
+    # Direction flip beats the plateau band: oscillation wins.
+    obs5 = ConvergenceObservatory(ewma_alpha=1.0, warmup_rounds=0)
+    obs5.observe(_tree(1.0, 0.0))
+    sig = obs5.observe(_tree(-1.0, 0.0))
+    assert sig["conv_cos_prev"] == pytest.approx(-1.0)
+    assert sig["conv_trend"] == "oscillation"
+    # Exactly at the oscillation threshold: NOT oscillation (strict <).
+    obs6 = ConvergenceObservatory(ewma_alpha=1.0, warmup_rounds=0,
+                                  oscillation_cos=-1.0)
+    obs6.observe(_tree(1.0, 0.0))
+    assert obs6.observe(_tree(-1.0, 0.0))["conv_trend"] == "plateau"
+
+
+def test_warmup_rounds_suppress_early_classification():
+    obs = ConvergenceObservatory(warmup_rounds=2)
+    assert obs.observe(_tree(1.0))["conv_trend"] == "warmup"
+    assert obs.observe(_tree(100.0))["conv_trend"] == "warmup"
+    # Third observation is past warmup: the 100x blowup classifies.
+    assert obs.observe(_tree(1000.0))["conv_trend"] == "divergence"
+
+
+def test_nonfinite_norm_classifies_divergence_and_clears_prev():
+    obs = ConvergenceObservatory()
+    obs.observe(_tree(1.0, 0.0))
+    ewma_before = obs._ewma
+    sig = obs.observe(_tree(float("inf"), 0.0))
+    assert sig["conv_trend"] == "divergence"
+    assert not math.isfinite(sig["conv_update_norm"])
+    # The EWMA is NOT poisoned and the prev update is cleared, so the
+    # next finite round carries no cosine against garbage.
+    assert obs._ewma == ewma_before
+    sig2 = obs.observe(_tree(1.0, 0.0))
+    assert "conv_cos_prev" not in sig2
+
+
+def test_export_metrics_uses_catalog_declared_names():
+    from colearn_federated_learning_tpu.analysis import metric_catalog
+
+    obs = ConvergenceObservatory()
+    reg = MetricsRegistry()
+    sig = obs.observe(_tree(1.0, 2.0), lr=0.1)
+    sig["conv_cohort_skew"] = 0.25
+    obs.export_metrics(reg, sig)
+    snap = reg.snapshot()
+    assert snap["learn.update_norm"] == pytest.approx(math.sqrt(5.0))
+    assert snap["learn.cohort_skew"] == pytest.approx(0.25)
+    assert snap["learn.trend_total{trend=warmup}"] == 1
+    for name in snap:
+        assert metric_catalog.is_known(name), name
+
+
+# ------------------------------------------------------ skew attribution --
+def test_device_skew_median_p90_anomalies():
+    out = device_skew([1.0, 1.0, 1.0, 1.0, 10.0])
+    assert out["median"] == 1.0
+    assert out["anomalies"] == [4]             # 10 > 3 x median
+    assert device_skew([]) == {"median": 0.0, "p90": 0.0, "anomalies": []}
+    # Uniform norms: nothing anomalous.
+    assert device_skew([2.0] * 8)["anomalies"] == []
+
+
+def test_cohort_skew_separates_aligned_from_opposed():
+    agg = _tree(1.0, 0.0)
+    # Two cohorts pushing exactly the aggregate's way: zero skew.
+    sums = {"layer": {"w": jnp.asarray([[2.0, 0.0], [4.0, 0.0]],
+                                       jnp.float32)}}
+    w = np.asarray([2.0, 4.0])
+    out = cohort_skew(sums, w, agg)
+    assert out["conv_cohort_skew"] == pytest.approx(0.0)
+    assert out["conv_cohort_cos_min"] == pytest.approx(1.0)
+    # One cohort pulling exactly opposite: skew 2 (cos -1).
+    sums_op = {"layer": {"w": jnp.asarray([[2.0, 0.0], [-4.0, 0.0]],
+                                          jnp.float32)}}
+    out_op = cohort_skew(sums_op, w, agg)
+    assert out_op["conv_cohort_cos_min"] == pytest.approx(-1.0)
+    assert out_op["conv_cohort_skew"] == pytest.approx(2.0)
+    # Zero-weight cohorts are skipped, not divided by.
+    out_zw = cohort_skew(sums_op, np.asarray([2.0, 0.0]), agg)
+    assert out_zw["conv_cohort_skew"] == pytest.approx(0.0)
+    # No populated cohorts at all: neutral defaults.
+    empty = cohort_skew(sums, np.asarray([0.0, 0.0]), agg)
+    assert empty == {"conv_cohort_skew": 0.0, "conv_cohort_cos_min": 1.0}
+
+
+# ------------------------------------------------------------- reporting --
+def test_render_convergence_report_shapes():
+    assert render_convergence_report([]).startswith(
+        "no learning signals found")
+    recs = [
+        {"round": 1, "conv_update_norm": 0.5, "conv_step_size": 0.5,
+         "conv_norm_ewma": 0.75, "conv_trend": "progress",
+         "conv_cos_prev": 0.9, "conv_cohort_skew": 0.3},
+        {"round": 0, "conv_update_norm": 1.0, "conv_step_size": 1.0,
+         "conv_norm_ewma": 1.0, "conv_trend": "warmup"},
+        {"round": 2, "conv_update_norm": 3.0, "conv_step_size": 3.0,
+         "conv_norm_ewma": 1.4, "conv_trend": "divergence",
+         "conv_cos_prev": 0.1},
+        {"round": 3, "unrelated": True},       # filtered out
+    ]
+    report = render_convergence_report(recs)
+    assert "trends: warmup=1  progress=1  divergence=1" in report
+    assert "first divergence: round 2" in report
+    assert "update_norm: first=1 last=3 max=3" in report
+    assert "cohort_skew: mean=0.3000 max=0.3000" in report
+    # Rows are round-ordered regardless of input order.
+    lines = [ln for ln in report.splitlines() if ln[:5].strip().isdigit()]
+    assert [int(ln.split()[0]) for ln in lines] == [0, 1, 2]
+
+
+def test_cli_converge_report_and_empty_exit_codes(tmp_path, capsys):
+    from colearn_federated_learning_tpu.cli import main as cli_main
+
+    p = tmp_path / "results" / "events.jsonl"
+    p.parent.mkdir()
+    rows = [{"event": "round", "round": r, "conv_update_norm": 1.0 / (r + 1),
+             "conv_step_size": 1.0 / (r + 1), "conv_norm_ewma": 1.0,
+             "conv_trend": "progress"} for r in range(3)]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert cli_main(["converge", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "trends: progress=3" in out
+    # A dir with no learning signals exits 1 (grep-able failure).
+    empty = tmp_path / "none"
+    empty.mkdir()
+    (empty / "x.jsonl").write_text(json.dumps({"round": 0}) + "\n")
+    assert cli_main(["converge", str(empty)]) == 1
+
+
+# -------------------------------------------------- lr-spike chaos knob --
+def test_lr_spike_overlay_on_constant_schedule():
+    base = FedConfig(strategy="fedavg")
+    # Default: the constant schedule compiles the scaling branch away.
+    assert strategies.lr_scale_for_round(base, 3) is None
+    spiked = FedConfig(strategy="fedavg", lr_spike_round=5,
+                       lr_spike_multiplier=10.0)
+    assert float(strategies.lr_scale_for_round(spiked, 5)) == 10.0
+    assert float(strategies.lr_scale_for_round(spiked, 4)) == 1.0
+    assert float(strategies.lr_scale_for_round(spiked, 6)) == 1.0
+
+
+def test_lr_spike_composes_with_cosine_schedule():
+    cfg = FedConfig(strategy="fedavg", rounds=10, lr_schedule="cosine")
+    cfg_sp = FedConfig(strategy="fedavg", rounds=10, lr_schedule="cosine",
+                       lr_spike_round=4, lr_spike_multiplier=10.0)
+    clean = float(strategies.lr_scale_for_round(cfg, 4))
+    assert float(strategies.lr_scale_for_round(cfg_sp, 4)) == \
+        pytest.approx(10.0 * clean)
+    assert float(strategies.lr_scale_for_round(cfg_sp, 5)) == \
+        pytest.approx(float(strategies.lr_scale_for_round(cfg, 5)))
+
+
+def test_validate_robustness_rejects_bad_spike_knobs():
+    with pytest.raises(ValueError, match="lr_spike_round"):
+        validate_robustness(_fleet_config(False, lr_spike_round=-2))
+    with pytest.raises(ValueError, match="lr_spike_multiplier"):
+        validate_robustness(_fleet_config(False,
+                                          lr_spike_multiplier=0.0))
+
+
+# --------------------------------------------- trace summary + colearn top
+def _trace_doc(with_conv: bool) -> dict:
+    args = {"trace_id": "t", "span_id": "a", "parent_id": None}
+    if with_conv:
+        args.update(conv_update_norm=0.5, conv_trend="progress")
+    return {"traceEvents": [
+        {"name": "aggregate", "ph": "X", "pid": 1, "tid": 0, "ts": 0,
+         "dur": 10_000, "args": args},
+    ]}
+
+
+def test_trace_summary_learning_line_both_shapes():
+    with_line = telemetry.summarize_trace(_trace_doc(True))
+    assert "learning: 1 observed fold(s)" in with_line
+    assert "trend progress=1" in with_line
+    without = telemetry.summarize_trace(_trace_doc(False))
+    assert "learning:" not in without
+
+
+def test_render_top_learning_section_both_shapes():
+    from colearn_federated_learning_tpu.telemetry import runtime
+
+    snap = {"fed.rounds_total": 4, "learn.update_norm": 0.125,
+            "learn.update_norm_ewma": 0.25, "learn.step_size": 0.0625,
+            "learn.cos_prev": 0.91, "learn.cohort_skew": 0.4,
+            "learn.trend_total{trend=progress}": 3,
+            "learn.trend_total{trend=warmup}": 2}
+    body = runtime.render_top(snap)
+    assert "learning" in body
+    assert "update norm" in body and "0.125000" in body
+    assert "cos(prev update)" in body and "0.9100" in body
+    assert "cohort skew" in body
+    assert "progress 3" in body and "warmup 2" in body
+    # Default snapshots keep the classic layout: no learning section.
+    assert "learning" not in runtime.render_top({"fed.rounds_total": 4})
+
+
+# ------------------------------------------ fleetsim plane (records+jit) --
+def _fleet_config(learn_observe: bool, **fed_kw) -> ExperimentConfig:
+    fed = dict(strategy="fedavg", local_steps=2, batch_size=8, lr=0.05,
+               momentum=0.0)
+    fed.update(fed_kw)
+    return ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32,
+                          depth=1),
+        fed=FedConfig(**fed),
+        run=RunConfig(name="conv_test", seed=0,
+                      learn_observe=learn_observe),
+    )
+
+
+def _make_fleet(learn_observe: bool, num_devices=64, cohort=16, chunk=16,
+                **fed_kw):
+    spec = fleetsim.PopulationSpec(num_devices=num_devices, feature_dim=16,
+                                   shard_capacity=16, min_examples=4,
+                                   label_skew=0.9, seed=0)
+    population = fleetsim.DevicePopulation(spec)
+    traffic = fleetsim.TrafficModel(
+        fleetsim.TrafficSpec(base_rate=2000.0, diurnal_amplitude=0.0),
+        num_devices)
+    return fleetsim.FleetSim.from_population(
+        _fleet_config(learn_observe, **fed_kw), population, traffic,
+        cohort_size=cohort, chunk_size=chunk)
+
+
+def test_fleetsim_default_records_have_no_conv_keys():
+    fs = _make_fleet(learn_observe=False)
+    hist = fs.fit(2)
+    for rec in hist:
+        assert not any(k.startswith("conv_") for k in rec), sorted(rec)
+    # The default jitted trio is untouched: no observatory program.
+    assert fs.compile_counts == {"chunk": 1, "finish": 1, "fold": 1}
+
+
+def test_fleetsim_observed_records_carry_conv_trail():
+    fs = _make_fleet(learn_observe=True)
+    hist = fs.fit(3)
+    for rec in hist:
+        assert rec["conv_update_norm"] > 0
+        assert rec["conv_trend"] in telemetry.convergence.TRENDS
+        # Updates are simulation-local: per-device and per-cohort skew
+        # attribution rides along.
+        assert rec["conv_norm_median"] > 0
+        assert 0.0 <= rec["conv_cohort_skew"] <= 2.0
+    assert "conv_cos_prev" not in hist[0]
+    assert all("conv_cos_prev" in r for r in hist[1:])
+    # The observatory adds its own program; the default trio still
+    # compiles once each (the chunked-vmap invariant holds).
+    assert fs.compile_counts == {"chunk": 0, "finish": 1, "fold": 1,
+                                 "obs_chunk": 1}
+
+
+def test_fleetsim_async_observed_records_carry_conv_trail():
+    fs = _make_fleet(learn_observe=True, num_devices=32, cohort=8, chunk=8)
+    hist = fs.fit_async(6, buffer_size=4, max_staleness=8)
+    assert all("conv_update_norm" in r for r in hist)
+    assert all(r["conv_trend"] in telemetry.convergence.TRENDS
+               for r in hist)
+    fs2 = _make_fleet(learn_observe=False, num_devices=32, cohort=8,
+                      chunk=8)
+    hist2 = fs2.fit_async(6, buffer_size=4, max_staleness=8)
+    for rec in hist2:
+        assert not any(k.startswith("conv_") for k in rec), sorted(rec)
+
+
+# ------------------------------------------- socket planes (sync, async) --
+def _socket_config(learn_observe: bool, num_clients=3) -> ExperimentConfig:
+    from colearn_federated_learning_tpu.utils.config import DataConfig
+
+    return ExperimentConfig(
+        data=DataConfig(dataset="mnist_tiny", num_clients=num_clients,
+                        partition="iid"),
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=32,
+                          depth=2),
+        fed=FedConfig(strategy="fedavg", rounds=2, cohort_size=0,
+                      local_steps=2, batch_size=16, lr=0.1),
+        run=RunConfig(name="conv_socket_test", backend="cpu",
+                      learn_observe=learn_observe),
+    )
+
+
+def test_sync_coordinator_observed_records_carry_conv_trail():
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+    from colearn_federated_learning_tpu.comm.coordinator import (
+        FederatedCoordinator,
+    )
+    from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+
+    cfg = _socket_config(learn_observe=True)
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(3)]
+        try:
+            coord = FederatedCoordinator(cfg, broker.host, broker.port,
+                                         round_timeout=60.0,
+                                         want_evaluator=False)
+            coord.enroll(min_devices=3, timeout=20.0)
+            hist = coord.fit(rounds=2)
+            coord.close()
+        finally:
+            for w in workers:
+                w.stop()
+    assert len(hist) == 2
+    for rec in hist:
+        assert rec["conv_update_norm"] > 0
+        assert rec["conv_trend"] in telemetry.convergence.TRENDS
+        assert rec["conv_step_size"] == pytest.approx(
+            rec["conv_update_norm"] * cfg.fed.server_lr)
+    assert "conv_cos_prev" not in hist[0]
+    assert "conv_cos_prev" in hist[1]
+
+
+def test_async_coordinator_observed_records_carry_conv_trail():
+    from colearn_federated_learning_tpu.comm.async_coordinator import (
+        AsyncFederatedCoordinator,
+    )
+    from colearn_federated_learning_tpu.comm.broker import MessageBroker
+    from colearn_federated_learning_tpu.comm.worker import DeviceWorker
+
+    cfg = _socket_config(learn_observe=True)
+    with MessageBroker() as broker:
+        workers = [DeviceWorker(cfg, i, broker.host, broker.port).start()
+                   for i in range(3)]
+        try:
+            with AsyncFederatedCoordinator(
+                cfg, broker.host, broker.port, buffer_size=2,
+                want_evaluator=False,
+            ) as coord:
+                coord.enroll(min_devices=3, timeout=20.0)
+                recs = [coord.run_aggregation() for _ in range(2)]
+        finally:
+            for w in workers:
+                w.stop()
+    for rec in recs:
+        assert rec["conv_update_norm"] > 0
+        assert rec["conv_trend"] in telemetry.convergence.TRENDS
+    assert "conv_cos_prev" not in recs[0]
+    assert "conv_cos_prev" in recs[1]
+
+
+def test_fleetsim_drift_separates_noniid_from_iid():
+    # The committed bench row's acceptance in miniature: matched seeds,
+    # only the label skew differs, the cohort-skew signal separates.
+    def mean_skew(label_skew: float) -> float:
+        spec = fleetsim.PopulationSpec(num_devices=48, feature_dim=16,
+                                       shard_capacity=16, min_examples=4,
+                                       label_skew=label_skew, seed=0)
+        population = fleetsim.DevicePopulation(spec)
+        traffic = fleetsim.TrafficModel(
+            fleetsim.TrafficSpec(base_rate=2000.0, diurnal_amplitude=0.0),
+            48)
+        fs = fleetsim.FleetSim.from_population(
+            _fleet_config(True), population, traffic, cohort_size=16,
+            chunk_size=16)
+        hist = fs.fit(4)
+        vals = [r["conv_cohort_skew"] for r in hist[1:]]
+        return sum(vals) / len(vals)
+
+    assert mean_skew(0.9) > mean_skew(0.0) + 0.2

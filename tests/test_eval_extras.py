@@ -84,7 +84,11 @@ def test_same_seed_is_bitwise_deterministic():
     for _ in range(3):
         ra = a.run_round()
         rb = b.run_round()
-        assert ra == rb
+        # phase_* keys are wall-clock phase timings — observability, not
+        # learning state — and legitimately differ run to run.
+        assert ({k: v for k, v in ra.items() if not k.startswith("phase_")}
+                == {k: v for k, v in rb.items()
+                    if not k.startswith("phase_")})
     pa = np.asarray(next(iter(jax_leaves(a))))
     pb = np.asarray(next(iter(jax_leaves(b))))
     np.testing.assert_array_equal(pa, pb)

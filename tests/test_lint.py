@@ -1,5 +1,5 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL015), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL016), the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -878,6 +878,64 @@ def test_cl015_suppression(tmp_path):
             for _ in range(3):
                 time.sleep(0.01)  # colearn: noqa(CL015)
     """, relpath="pkg/comm/transport.py", rules=["CL015"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl016_flags_uncataloged_record_key(tmp_path):
+    res = run_lint(tmp_path, """
+        def _round(self, r):
+            rec = {"round": r, "completed": 3}
+            rec["train_los"] = 1.0
+            return rec
+    """, relpath="pkg/comm/coordinator.py", rules=["CL016"])
+    assert rule_ids(res) == ["CL016"]
+    assert res.exit_code == 1
+    assert "train_los" in res.findings[0].message
+
+
+def test_cl016_flags_typo_in_dict_literal_and_update(tmp_path):
+    # Dict-literal assignment to a record name and .update kwargs are
+    # both validated against the catalog.
+    res = run_lint(tmp_path, """
+        def _round(self, r):
+            rec = {"round": r, "cohrt": 4}
+            rec.update(train_loss=0.5, stalenes_mean=1.0)
+            return rec
+    """, relpath="pkg/fleetsim/sim.py", rules=["CL016"])
+    assert rule_ids(res) == ["CL016"]
+    assert len(res.findings) == 2
+    flagged = {f.message.split("'")[1] for f in res.findings}
+    assert flagged == {"cohrt", "stalenes_mean"}
+
+
+def test_cl016_allows_cataloged_keys_and_dynamic_updates(tmp_path):
+    # Cataloged keys pass; **splat and computed updates are out of
+    # scope (their keys are cataloged at the call sites that build them).
+    res = run_lint(tmp_path, """
+        def _round(self, r, extras):
+            rec = {"round": r, "completed": 3, "train_loss": 0.1}
+            rec["conv_update_norm"] = 0.5
+            rec.update(**extras)
+            rec.update({"staleness_mean": 1.0})
+            return rec
+    """, relpath="pkg/comm/async_coordinator.py", rules=["CL016"])
+    assert res.findings == []
+    # Wire-header dicts in other comm/ files keep their own vocabulary.
+    res = run_lint(tmp_path, """
+        def reply(self):
+            out = {"op": "subscribe_ack", "status": "ok"}
+            return out
+    """, relpath="pkg/comm/broker.py", rules=["CL016"])
+    assert res.findings == []
+
+
+def test_cl016_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def _round(self, r):
+            rec = {"round": r}
+            rec["experimental_key"] = 1  # colearn: noqa(CL016)
+            return rec
+    """, relpath="pkg/comm/coordinator.py", rules=["CL016"])
     assert res.findings == [] and res.suppressed == 1
 
 
