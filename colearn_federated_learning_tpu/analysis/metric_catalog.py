@@ -104,6 +104,14 @@ COUNTERS = (
     "async.contribution_mass",       # Σ(1+τ)^-α, labeled {outcome=folded|...}
     "async.pump_stalls_total",       # dispatch slower than timeout/2, {device=}
     "async.buffer_resizes_total",    # auto-K changed the fold threshold
+    # buffered-async aggregator tree (comm/aggregator.py buffered ops,
+    # comm/async_coordinator.py tree mode)
+    "comm.agg_buffer_staged_total",   # labeled {agg=<id>}: abuf contributions
+    "comm.agg_buffer_dedup_total",    # duplicate dedup-key overwrites, {agg=}
+    "comm.agg_partials_shipped_total",  # adrain partials sent up, {agg=<id>}
+    "comm.agg_rehomed_total",         # contributions re-sent to a sibling
+    "async.partials_folded_total",    # root-side tree folds, {agg=<id>}
+    "async.partials_discarded_stale",  # whole partial past max_staleness
     # fleet simulation (fleetsim/sim.py)
     "fleetsim.rounds_total",
     "fleetsim.clients_trained_total",
@@ -112,6 +120,8 @@ COUNTERS = (
     "fleetsim.async_devices_pruned_total",
     "fleetsim.async_contribution_mass",   # labeled {outcome=folded|discarded}
     "fleetsim.async_buffer_resizes_total",  # auto-K resizes (virtual clock)
+    "fleetsim.async_partials_folded_total",   # two-tier mode, {agg=<slice>}
+    "fleetsim.async_partials_discarded_total",  # whole partial too stale
     "fleetsim.bytes_up_est_total",     # wire-codec frame estimate, uplink
     "fleetsim.bytes_down_est_total",   # wire-codec frame estimate, downlink
     "fleetsim.bytes_gather_avoided_est_total",  # sharded-downlink estimate
@@ -157,6 +167,10 @@ GAUGES = (
     # aggregator tier visibility (comm/coordinator.py → `colearn top`)
     "comm.agg_heartbeat_age_s",      # labeled {agg=<id>}: announce staleness
     "comm.agg_slice_devices",        # labeled {agg=<id>}: dispatch slice size
+    # buffered-async aggregator tree: per-slice buffer visibility
+    "comm.agg_buffer_k",             # labeled {agg=<id>}: auto-K in force
+    "comm.agg_buffer_occupancy",     # labeled {agg=<id>}: staged, undrained
+    "comm.agg_arrival_rate_per_s",   # labeled {agg=<id>}: slice-local EWMA
     # staleness observatory (comm/async_coordinator.py, telemetry/arrival.py)
     "async.buffer_target",           # K in force for the current aggregation
     "async.buffer_occupancy",        # updates folded into the open buffer
@@ -272,6 +286,12 @@ RECORD_KEYS_LIST = (
     "mass_folded", "mass_discarded", "arrival_rate_per_s",
     "staleness_p50", "staleness_p90", "staleness_p99", "pruned",
     "dp_z_eff",
+    # tree-async keys (comm/async_coordinator.py tree mode + fleetsim
+    # two-tier fit_async; absent unless num_aggregators/aggregators > 0,
+    # so default records stay byte-identical)
+    "agg_id", "agg_buffer_k", "agg_buffer_staged", "agg_buffer_rate_per_s",
+    "oldest_version", "folded_keys", "rehomed_devices", "rehomed_total",
+    "agg_fold_tracking_min",
     # fleetsim sync round record (fleetsim/sim.py run_round)
     "cohort_requested", "clients_trained", "bytes_down_est",
     "bytes_up_est", "bytes_gather_avoided_est", "bytes_up_saved_est",

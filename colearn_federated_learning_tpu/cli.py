@@ -120,6 +120,12 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--agg-heartbeat-timeout", type=float, default=None,
                    help="treat an aggregator as dead when its retained "
                         "heartbeat is older than this many seconds")
+    p.add_argument("--agg-buffer-interval", type=float, default=None,
+                   dest="agg_buffer_interval_s",
+                   help="tree-async fold cadence: each aggregator's "
+                        "per-slice buffer targets one partial ship per "
+                        "this many seconds (buffer depth auto-sizes from "
+                        "the slice's measured arrival rate)")
     p.add_argument("--compress-down", default=None,
                    choices=["none", "int8", "topk"],
                    help="DOWNLINK broadcast compression (synchronous "
@@ -277,7 +283,7 @@ _RUN_KEYS = {"backend", "seed", "tp_size", "eval_every", "log_every",
              "evict_after", "worker_enroll_timeout", "comm_retries",
              "comm_backoff_base", "comm_backoff_max", "fault_plan",
              "fault_seed", "num_aggregators", "agg_heartbeat_timeout",
-             "health_dir", "learn_observe"}
+             "agg_buffer_interval_s", "health_dir", "learn_observe"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
@@ -631,6 +637,10 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
                 _coordinator_resume(coord)
             coord.enroll(min_devices=args.min_devices,
                          timeout=args.enroll_timeout)
+            if coord.tree_mode:
+                aggs = coord.enroll_aggregators(timeout=args.enroll_timeout)
+                print(json.dumps({"event": "aggregators_enrolled",
+                                  "aggregators": aggs}), file=sys.stderr)
             remaining = max(0, config.fed.rounds - len(coord.history))
             hist = coord.fit(
                 aggregations=remaining,
@@ -695,6 +705,43 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print("--async is its own multi-process gate; "
               "drop --secure/--mp/--agg", file=sys.stderr)
         return 2
+    if args.chaos_tree_async and (args.secure or args.mp or args.agg
+                                  or args.chaos_async):
+        print("--tree-async is its own multi-process gate; "
+              "drop --secure/--mp/--agg/--async", file=sys.stderr)
+        return 2
+    if args.chaos_tree_async:
+        from colearn_federated_learning_tpu.faults import procsoak
+
+        summary = procsoak.run_tree_async_soak(
+            aggregations=args.rounds, n_workers=args.num_workers,
+            workdir=args.workdir, round_timeout=args.mp_round_timeout,
+            timeout_s=args.mp_timeout, kill=not args.no_faults,
+            log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+        )
+        print(json.dumps(summary))
+        ok = (summary["exit_code"] == 0
+              and summary["oracle_exit_code"] == 0
+              and summary["aggregations_run"] >= args.rounds
+              and summary["oracle_aggregations_run"] >= args.rounds
+              and summary["version_monotonic"]
+              # The tree-async invariants a dead aggregator must not
+              # break: a contribution folds exactly once (re-home with
+              # ack-on-receipt), the tail loss tracks the kill-free
+              # tree oracle, and the health ledgers survive.
+              and summary["double_folds"] == 0
+              and summary["loss_gap_ok"]
+              and summary["health_ledger_ok"]
+              # With the kills armed the gate must have EXERCISED the
+              # failover: at least one re-home/drop, every re-homed
+              # device attributed in the ledger, the dead aggregator
+              # named by the postmortem, its flight dump on disk.
+              and (args.no_faults
+                   or (summary["failover_fired"]
+                       and summary["rehomed_attributed"]
+                       and summary["postmortem_attributed"]
+                       and not summary["flight_missing"])))
+        return 0 if ok else 1
     if args.chaos_async:
         from colearn_federated_learning_tpu.faults import procsoak
 
@@ -894,6 +941,7 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
             prune_after=args.async_prune_after,
             probation=args.async_probation,
             observe=args.async_observe,
+            aggregators=args.aggregators,
             log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr))
         last = history[-1]
         # Arrival tracking: what fraction of arrived updates were folded
@@ -928,6 +976,9 @@ def cmd_fleetsim(args: argparse.Namespace) -> int:
             summary["staleness_p99"] = hs["p99"]
         if args.async_buffer == "auto":
             summary["buffer_auto"] = True
+        if args.aggregators:
+            summary["aggregators"] = args.aggregators
+            summary["agg_fold_tracking_min"] = last["agg_fold_tracking_min"]
         if args.async_prune_after:
             summary["pruned"] = last["pruned"]
             summary["pruned_total"] = last["pruned_total"]
@@ -1382,6 +1433,17 @@ def main(argv: list[str] | None = None) -> int:
                               "accountant replay, and final loss vs a "
                               "same-seed kill-free async run "
                               "(faults/procsoak.run_async_soak)")
+    p_chaos.add_argument("--tree-async", dest="chaos_tree_async",
+                         action="store_true",
+                         help="buffered-async THROUGH the aggregator "
+                              "tree: 2 per-slice aggregator buffers, "
+                              "aggregator 0 SIGKILLed mid-aggregation "
+                              "(stays dead — its in-flight slice must "
+                              "re-home to the sibling with zero double-"
+                              "folds) plus a broker kill-and-rebind; "
+                              "tail-loss parity vs a same-seed kill-free "
+                              "tree oracle "
+                              "(faults/procsoak.run_tree_async_soak)")
     p_chaos.add_argument("--workdir", default=None,
                          help="--mp scratch dir for checkpoints + process "
                               "logs (default: a fresh temp dir)")
@@ -1461,6 +1523,13 @@ def main(argv: list[str] | None = None) -> int:
                          help="async mode: stop re-dispatching a device "
                               "after this many CONSECUTIVE too-stale "
                               "discards (0 = off)")
+    p_fleet.add_argument("--aggregators", type=int, default=0,
+                         help="async mode: two-tier tree — devices "
+                              "sliced by service time across N per-"
+                              "slice auto-K buffers, partials folded "
+                              "unscaled at the edge and staleness-"
+                              "discounted at the root against the "
+                              "OLDEST constituent (0 = flat async)")
     p_fleet.add_argument("--async-probation", type=int, default=8,
                          help="async mode: aggregations a pruned device "
                               "sits out before re-admission")

@@ -229,6 +229,24 @@ class StreamingFolder(UpdateFolder):
         self.count += int(count)
         self.fold_s += time.perf_counter() - t0
 
+    def has(self, key: str) -> bool:
+        """True while ``key`` is staged and not yet finalized."""
+        return str(key) in self._staged
+
+    def discard(self, key: str) -> bool:
+        """Drop one staged contribution before finalize (dedup/re-home:
+        the buffered aggregator discards the stale copy before re-staging
+        a contribution under the same dedup key, keeping ``count`` and the
+        fold itself single-copy).  Returns True when something was
+        dropped; a finalized folder refuses (the sum already includes the
+        contribution)."""
+        if self._finalized:
+            raise RuntimeError("StreamingFolder already finalized")
+        if self._staged.pop(str(key), None) is None:
+            return False
+        self.count -= 1
+        return True
+
     def _scatter_fold(self, acc: Any, stage: _SparseStage) -> Any:
         """Fold one sparse-staged contribution into the accumulator.
 

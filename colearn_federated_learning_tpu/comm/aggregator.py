@@ -85,6 +85,36 @@ def slice_cohort(cohort: Sequence[Any], n: int) -> list[list[Any]]:
     return out
 
 
+def _device_key(d: Any) -> str:
+    """Canonical string id for a cohort entry — device tuples
+    ``(id, host, port)`` on the sync plane, bare ids on the async one."""
+    if isinstance(d, (tuple, list)):
+        return str(int(d[0]))
+    return str(d)
+
+
+def assign_slices(cohort: Sequence[Any], n: int,
+                  scores: Optional[dict] = None) -> list[list[Any]]:
+    """Health-driven slice assignment: partition ``cohort`` into ``n``
+    slices of the same sizes as :func:`slice_cohort`, but ordered by the
+    health ledger's straggler scores (ascending) so chronic stragglers
+    concentrate in the LAST — deepest-buffer — slices instead of
+    poisoning every slice's fold cadence.
+
+    ``scores`` maps canonical device ids (str) to straggler scores;
+    ``None`` or an all-equal map degrades to the contiguous divmod
+    EXACTLY (the sort below is stable over the original order), so the
+    default data path — no health ledger — is byte-identical to before.
+    """
+    if scores is None:
+        return slice_cohort(cohort, n)
+    vals = [float(scores.get(_device_key(d), 0.0)) for d in cohort]
+    if len(set(vals)) <= 1:
+        return slice_cohort(cohort, n)
+    order = sorted(range(len(cohort)), key=lambda i: (vals[i], i))
+    return slice_cohort([cohort[i] for i in order], n)
+
+
 class AggregatorServer:
     """One aggregator process: a tensor server folding its device slice.
 
@@ -132,6 +162,21 @@ class AggregatorServer:
                         backoff_max=config.run.comm_backoff_max)
             if config.run.comm_retries > 0 else None
         )
+        # Buffered-async state (tree-async mode): a per-slice buffer the
+        # root fills contribution-by-contribution ("abuf") and drains as
+        # partial folds ("adrain").  The slice's own arrival estimator
+        # sizes the fold threshold K (auto-K, slew-limited).
+        from colearn_federated_learning_tpu.telemetry.arrival import (
+            ArrivalEstimator,
+        )
+
+        self.arrival = ArrivalEstimator()
+        self._abuf_cv = threading.Condition()
+        self._abuf_folder = None            # StreamingFolder | None
+        self._abuf_shapes = None
+        self._abuf_entries: dict[str, dict] = {}   # dedup key -> bookkeeping
+        self._abuf_k: Optional[int] = None         # slew anchor
+        self._abuf_dedup = 0
 
     # ------------------------------------------------------------------
     @property
@@ -199,10 +244,182 @@ class AggregatorServer:
         op = header.get("op")
         if op == "fold":
             return self._fold(header, tree)
+        if op == "aprep":
+            return self._aprep(header, tree)
+        if op == "abuf":
+            return self._abuf(header, tree)
+        if op == "adrain":
+            return self._adrain(header)
         if op == "info":
             return ({"meta": {"agg_id": self.agg_id,
                               "host": self.host, "port": self.port}}, None)
         return ({"status": "error", "error": f"unknown op {op!r}"}, None)
+
+    # ------------------------------------------------- buffered (async) --
+    def _aprep(self, header: dict, tree: Any) -> tuple[dict, Any]:
+        """Install the fold-shapes template and (re)open an empty buffer.
+
+        The async root sends this once per aggregator connection — at
+        enrollment and again after an aggregator restart (a restarted
+        process announces on a fresh port with no buffered state, which
+        is what makes re-homing double-fold-free: contributions only
+        ever live in ONE process's buffer)."""
+        from colearn_federated_learning_tpu.comm.aggregation import (
+            StreamingFolder,
+        )
+
+        if tree is None:
+            return ({"status": "error",
+                     "error": "aprep carried no shapes template"}, None)
+        meta_in = header.get("meta") or {}
+        shapes = tree["factors"] if meta_in.get("lora") else tree
+        with self._abuf_cv:
+            self._abuf_shapes = shapes
+            self._abuf_folder = StreamingFolder(shapes)
+            self._abuf_entries = {}
+            self._abuf_dedup = 0
+            self._abuf_cv.notify_all()
+        return ({"meta": {"agg_id": self.agg_id, "prepared": True}}, None)
+
+    def _abuf(self, header: dict, tree: Any) -> tuple[dict, Any]:
+        """Stage ONE device contribution into the open buffer.
+
+        ``header["key"]`` is the per-contribution dedup key
+        (``{version:08d}@{device}``): staging is idempotent under it — a
+        duplicate (re-homed copy racing the original, or a root retry)
+        REPLACES the staged copy instead of folding twice.  The fold
+        itself happens at arrival (StreamingFolder.add: decompress +
+        scale, the dominant host cost), so drain time is just the cheap
+        deterministic summation."""
+        if tree is None:
+            return ({"status": "error",
+                     "error": "abuf carried no delta"}, None)
+        key = str(header.get("key"))
+        dev = str(header.get("device"))
+        meta = dict(header.get("meta") or {})
+        meta["client_id"] = key
+        reg = telemetry.get_registry()
+        with self._abuf_cv:
+            if self._abuf_folder is None:
+                return ({"status": "error",
+                         "error": "aggregator buffer not prepared "
+                                  "(aprep first)"}, None)
+            dup = self._abuf_folder.discard(key)
+            if dup:
+                self._abuf_dedup += 1
+                reg.counter("comm.agg_buffer_dedup_total",
+                            labels={"agg": str(self.agg_id)}).inc()
+            self._abuf_folder.add(meta, tree)
+            self._abuf_entries[key] = {
+                "device": dev,
+                "version": int(header.get("version", 0)),
+                "weight": float(meta.get("weight", 1.0)),
+                "rehomed": bool(header.get("rehomed")),
+            }
+            self.arrival.observe(dev, now=time.monotonic())
+            staged = len(self._abuf_entries)
+            self._abuf_cv.notify_all()
+        reg.counter("comm.agg_buffer_staged_total",
+                    labels={"agg": str(self.agg_id)}).inc()
+        reg.gauge("comm.agg_buffer_occupancy",
+                  labels={"agg": str(self.agg_id)}).set(staged)
+        return ({"meta": {"agg_id": self.agg_id, "staged": staged,
+                          "dedup": dup}}, None)
+
+    def _auto_k(self, interval_s: float, slice_devices: int) -> int:
+        """Auto-K for this slice: the K that folds once per
+        ``interval_s`` at the slice's observed arrival rate, clamped to
+        the slice size and slew-limited to [K/2, 3K/2] per drain (the
+        PR 14 controller idiom) so one burst cannot whiplash the
+        threshold.  Caller holds ``_abuf_cv``."""
+        hi = max(1, int(slice_devices)) if slice_devices else 1 << 10
+        cur = self._abuf_k if self._abuf_k is not None else min(4, hi)
+        k = self.arrival.recommend_buffer(interval_s, lo=1, hi=hi,
+                                          current=cur)
+        k = max(max(1, cur // 2), min(k, max(2, cur * 3 // 2)))
+        k = max(1, min(k, hi))
+        self._abuf_k = k
+        return k
+
+    def _adrain(self, header: dict) -> tuple[dict, Any]:
+        """Long-poll drain: block until the buffer reaches its auto-K (or
+        the poll budget expires), then finalize and ship ONE partial fold
+        upstream with the dispatch-version metadata the root needs to
+        resolve staleness against the partial's OLDEST constituent
+        version.  An empty expiry replies ``count: 0`` (idle poll)."""
+        interval = float(header.get("interval_s", 2.0))
+        budget = float(header.get("timeout", max(2.0 * interval, 1.0)))
+        slice_n = int(header.get("slice_devices", 0))
+        deadline = time.monotonic() + budget
+        reg = telemetry.get_registry()
+        with self._abuf_cv:
+            if self._abuf_folder is None:
+                return ({"status": "error",
+                         "error": "aggregator buffer not prepared "
+                                  "(aprep first)"}, None)
+            while True:
+                k = self._auto_k(interval, slice_n)
+                if len(self._abuf_entries) >= k:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0.0:
+                    break
+                self._abuf_cv.wait(timeout=min(remaining, 0.05))
+                if self._abuf_folder is None:
+                    return ({"status": "error",
+                             "error": "buffer reset mid-drain"}, None)
+            rate = self.arrival.rate()
+            reg.gauge("comm.agg_buffer_k",
+                      labels={"agg": str(self.agg_id)}).set(k)
+            reg.gauge("comm.agg_arrival_rate_per_s",
+                      labels={"agg": str(self.agg_id)}).set(rate)
+            if not self._abuf_entries:
+                return ({"meta": {"agg_id": self.agg_id, "count": 0,
+                                  "buffer_k": k,
+                                  "arrival_rate_per_s": rate}}, None)
+            folder = self._abuf_folder
+            entries = self._abuf_entries
+            dedup = self._abuf_dedup
+            # Re-open the window: arrivals racing this drain stage into
+            # the NEXT partial (never lost, never double-folded).
+            from colearn_federated_learning_tpu.comm.aggregation import (
+                StreamingFolder,
+            )
+
+            self._abuf_folder = StreamingFolder(self._abuf_shapes)
+            self._abuf_entries = {}
+            self._abuf_dedup = 0
+        folder.finalize()
+        keys = folder.folded_ids     # sorted: version-then-device order
+        devices = [entries[c]["device"] for c in keys]
+        versions = [entries[c]["version"] for c in keys]
+        weights = [entries[c]["weight"] for c in keys]
+        rehomed = sorted({entries[c]["device"] for c in keys
+                          if entries[c]["rehomed"]})
+        reg.counter("comm.agg_partials_shipped_total",
+                    labels={"agg": str(self.agg_id)}).inc()
+        reg.counter("comm.agg_folds_total",
+                    labels={"agg": str(self.agg_id)}).inc()
+        reg.gauge("comm.agg_buffer_occupancy",
+                  labels={"agg": str(self.agg_id)}).set(0)
+        out_meta = {
+            "agg_id": self.agg_id,
+            "count": len(keys),
+            "keys": keys,
+            "devices": devices,
+            "versions": versions,
+            "weights": weights,
+            "rehomed": rehomed,
+            "oldest_version": min(versions),
+            "total_w": folder.total_w,
+            "loss_sum": folder.loss_sum,
+            "buffer_k": k,
+            "dedup": dedup,
+            "fold_s": folder.fold_s,
+            "densify_avoided": folder.densify_avoided,
+            "arrival_rate_per_s": rate,
+        }
+        return ({"meta": out_meta}, folder.wsum)
 
     def _fold(self, header: dict, tree: Any) -> tuple[dict, Any]:
         """Relay the broadcast to this slice's devices, fold the replies

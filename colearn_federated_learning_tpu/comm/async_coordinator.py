@@ -211,6 +211,7 @@ class AsyncFederatedCoordinator:
         self.want_evaluator = want_evaluator
         self._broker = BrokerClient(broker_host, broker_port,
                                     timeout=protocol.CONNECT_TIMEOUT)
+        self._mud_policy = mud_policy
         self._enroll = EnrollmentManager(self._broker, mud_policy=mud_policy)
         params = setup_lib.init_global_params(config)
         # Sharded server (PR 9): with run.tp_size > 1 the global model and
@@ -297,6 +298,36 @@ class AsyncFederatedCoordinator:
 
         self.accountant = RdpAccountant.from_config(config.fed,
                                                     sampling_rate=1.0)
+        # ---- buffered-async aggregator tree (tree mode) ------------------
+        # With run.num_aggregators > 0 the pumps stop feeding the local
+        # results queue and instead stream each contribution to its
+        # assigned aggregator's per-slice buffer ("abuf"); one drainer
+        # thread per aggregator long-polls partial folds back ("adrain")
+        # and run_aggregation resolves staleness at the root against each
+        # partial's OLDEST constituent version.  All of it is off — and
+        # every queue/thread below inert — in the default flat mode.
+        self.num_aggregators = int(config.run.num_aggregators)
+        self.tree_mode = self.num_aggregators > 0
+        self.agg_interval_s = float(config.run.agg_buffer_interval_s)
+        self._broker_addr = (broker_host, broker_port)
+        self._aggs: dict[int, dict] = {}        # agg_id -> announce record
+        self._agg_lock = threading.Lock()
+        self._agg_sub: Optional[BrokerClient] = None
+        # Sticky-dead addresses: once an aggregator PROCESS (host, port)
+        # is declared dead, nothing is ever drained from that address
+        # again — with per-key idempotent staging and re-home-from-dead-
+        # only, this is what makes double folds impossible.  A restarted
+        # aggregator announces on a fresh port with an empty buffer.
+        self._dead_addrs: set = set()
+        self._dead_aggs: set = set()
+        self._assign: dict[str, int] = {}       # device -> agg_id
+        self._inflight: dict[str, tuple] = {}   # dedup key -> contribution
+        self._inflight_lock = threading.Lock()
+        self._partials: queue.Queue = queue.Queue()
+        self._drainers: list[threading.Thread] = []
+        self._failovers_pending = 0
+        self._rehomed_pending: set = set()
+        self._rehomed_total = 0
 
     # ------------------------------------------------------------------
     def enroll(self, min_devices: int, timeout: float = 30.0) -> None:
@@ -317,8 +348,14 @@ class AsyncFederatedCoordinator:
             self._version_cv.notify_all()
         for t in self._threads:
             t.join(timeout=2 * self.request_timeout)
+        for t in self._drainers:
+            t.join(timeout=2 * self.agg_interval_s + 2.0)
         for c in self._clients.values():
             c.close()
+        with self._agg_lock:
+            if self._agg_sub is not None:
+                self._agg_sub.close()
+                self._agg_sub = None
         self._broker.close()
         if self._ckpt is not None:
             self._ckpt.close()
@@ -454,6 +491,13 @@ class AsyncFederatedCoordinator:
                 self._record_health(dev.device_id, pump_stall=1)
             self.arrival.observe(dev.device_id, now=time.monotonic())
             last_v = v
+            if self.tree_mode:
+                # Tree mode: the contribution streams to its assigned
+                # aggregator's per-slice buffer under a per-contribution
+                # dedup key; it stays in _inflight until a drained
+                # partial acknowledges it (re-home coverage).
+                self._tree_submit(dev.device_id, header["meta"], delta, v)
+                continue
             # The update travels with its dispatch span context (version
             # lineage) and its arrival time (buffer-wait attribution).
             self._results.put((dev.device_id, header["meta"], delta, v,
@@ -588,12 +632,363 @@ class AsyncFederatedCoordinator:
             admit_late_joiners,
         )
 
-        admitted = admit_late_joiners(self._enroll, self._broker,
-                                      self.trainers, self.evaluator,
-                                      self._clients, poll)
+        if not self._broker.alive():
+            # Control-plane SPOF healed in place, async flavor: a
+            # SIGKILLed-and-restarted broker loses our enrollment
+            # subscription; the fresh manager's retained-topic replay
+            # re-admits the fleet (pumps keep dispatching the whole
+            # time — training rides direct tensor connections).
+            self._rebuild_broker()
+        try:
+            admitted = admit_late_joiners(self._enroll, self._broker,
+                                          self.trainers, self.evaluator,
+                                          self._clients, poll)
+        except (OSError, protocol.ConnectionClosed):
+            # Broker died between the liveness check and the poll (a
+            # SIGKILL mid-recv surfaces as ConnectionClosed — the
+            # tree-async soak kills exactly this window).
+            self._rebuild_broker()
+            return []
         if admitted and self._threads:
             self._start_dispatchers()      # pumps for the newcomers only
+        if admitted and self.tree_mode:
+            with self._agg_lock:
+                self._recompute_assignment()
         return admitted
+
+    def _rebuild_broker(self) -> None:
+        """Reconnect the control plane after a broker death.
+        Aggregations keep running either way (contributions ride direct
+        tensor connections; only membership refresh and the aggregator
+        announce topic need the broker) — the outcome is counted, never
+        silent, and ``_refresh_aggs`` heals its own subscription on its
+        next call."""
+        reg = telemetry.get_registry()
+        try:
+            fresh = BrokerClient(self._broker_addr[0], self._broker_addr[1],
+                                 timeout=protocol.CONNECT_TIMEOUT)
+        except OSError:
+            reg.counter("comm.broker_reconnects_total",
+                        labels={"outcome": "failed"}).inc()
+            return
+        self._broker.close()
+        self._broker = fresh
+        self._enroll = EnrollmentManager(fresh, mud_policy=self._mud_policy)
+        reg.counter("comm.broker_reconnects_total",
+                    labels={"outcome": "ok"}).inc()
+
+    # ---- aggregator tree (tree-async mode) ---------------------------
+    def enroll_aggregators(self, n: Optional[int] = None,
+                           timeout: float = 30.0) -> list[int]:
+        """Discover ``n`` aggregators from their retained announce
+        records, mark them live, and start one drainer thread per
+        aggregator slot.  Call after :meth:`enroll` (slice assignment
+        needs the trainer roster)."""
+        from colearn_federated_learning_tpu.comm import aggregator as agg_lib
+
+        n = self.num_aggregators if n is None else int(n)
+        deadline = time.monotonic() + timeout
+        while True:
+            self._refresh_aggs(drain_timeout=0.2)
+            with self._agg_lock:
+                found = len(self._aggs)
+            if found >= n:
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"only {found}/{n} aggregators announced within "
+                    f"{timeout:.0f}s")
+        with self._agg_lock:
+            ids = sorted(self._aggs)
+            self._recompute_assignment()
+        for aid in ids:
+            t = threading.Thread(target=self._drain_loop, args=(aid,),
+                                 daemon=True, name=f"agg-drain-{aid}")
+            t.start()
+            self._drainers.append(t)
+        return ids
+
+    def _refresh_aggs(self, drain_timeout: float = 0.02) -> None:
+        """Drain the retained announce topic into ``_aggs`` (latest
+        record per agg_id wins — a restarted aggregator overwrites its
+        dead predecessor's address).  Heals the subscription in place
+        when the broker itself was restarted."""
+        from colearn_federated_learning_tpu.comm import aggregator as agg_lib
+
+        with self._agg_lock:
+            if self._agg_sub is None:
+                try:
+                    sub = BrokerClient(self._broker_addr[0],
+                                       self._broker_addr[1],
+                                       timeout=protocol.CONNECT_TIMEOUT)
+                    sub.subscribe(agg_lib.AGG_TOPIC + "#")
+                except OSError:
+                    telemetry.get_registry().counter(
+                        "comm.broker_reconnects_total",
+                        labels={"outcome": "failed"}).inc()
+                    return
+                self._agg_sub = sub
+            try:
+                agg_lib.fetch_aggregators(self._agg_sub, self._aggs,
+                                          drain_timeout=drain_timeout)
+            except (protocol.ConnectionClosed, OSError):
+                try:
+                    self._agg_sub.close()
+                finally:
+                    self._agg_sub = None   # broker died; rebuilt next call
+
+    def _live_agg_ids(self) -> list[int]:
+        with self._agg_lock:
+            return sorted(a for a in self._aggs if a not in self._dead_aggs)
+
+    def _recompute_assignment(self) -> None:
+        """Device → aggregator map over the LIVE aggregators, health-
+        driven when a ledger is attached (chronic stragglers concentrate
+        in the last — deepest-buffer — slices).  Caller holds
+        ``_agg_lock``."""
+        from colearn_federated_learning_tpu.comm import aggregator as agg_lib
+
+        live = sorted(a for a in self._aggs if a not in self._dead_aggs)
+        if not live:
+            self._assign = {}
+            return
+        ids = sorted((t.device_id for t in self.trainers), key=str)
+        scores = None
+        if self.health is not None:
+            with self._health_lock:
+                fleet = self.health.devices()
+            if fleet:
+                scores = {str(d): h.score() for d, h in fleet.items()}
+        slices = agg_lib.assign_slices(ids, len(live), scores=scores)
+        assign: dict[str, int] = {}
+        reg = telemetry.get_registry()
+        for aid, sl in zip(live, slices):
+            for d in sl:
+                assign[d] = aid
+            reg.gauge("comm.agg_slice_devices",
+                      labels={"agg": str(aid)}).set(float(len(sl)))
+        self._assign = assign
+
+    def _slice_size(self, aid: int) -> int:
+        with self._agg_lock:
+            return sum(1 for a in self._assign.values() if a == aid)
+
+    def _agg_failure(self, aid: int) -> None:
+        """One failed aggregator RPC: refresh the heartbeat view and
+        declare the aggregator dead only past the bounded detection
+        deadline (a transient hiccup on a live process is retried)."""
+        self._refresh_aggs()
+        now = time.time()
+        rehome_keys: list = []
+        with self._agg_lock:
+            info = self._aggs.get(aid)
+            if info is None or aid in self._dead_aggs:
+                return
+            age = now - float(info.get("ts", 0.0))
+            telemetry.get_registry().gauge(
+                "comm.agg_heartbeat_age_s",
+                labels={"agg": str(aid)}).set(age)
+            if age <= self.config.run.agg_heartbeat_timeout:
+                return
+            # Dead: sticky by ADDRESS — this process's buffer is gone
+            # and must never be drained again; a restart announces a
+            # fresh (host, port) and re-admits the slot.
+            self._dead_aggs.add(aid)
+            self._dead_addrs.add((str(info["host"]), int(info["port"])))
+            telemetry.get_registry().counter(
+                "comm.agg_heartbeat_expired_total").inc()
+            self._recompute_assignment()
+            with self._inflight_lock:
+                rehome_keys = [k for k, ent in self._inflight.items()
+                               if ent[4] == aid]
+        # Re-home OUTSIDE the locks: every contribution still in flight
+        # at the dead aggregator is re-sent to a live sibling under its
+        # original dedup key (idempotent staging at the receiver), and
+        # the device is attributed in the health ledger.
+        for key in rehome_keys:
+            with self._inflight_lock:
+                ent = self._inflight.get(key)
+            if ent is None or ent[4] != aid:
+                continue            # drained or already re-homed
+            dev_id, meta, delta, v, _ = ent
+            telemetry.get_registry().counter(
+                "comm.agg_failovers_total",
+                labels={"action": "rehome"}).inc()
+            telemetry.get_registry().counter(
+                "comm.agg_rehomed_total").inc()
+            with self._inflight_lock:
+                self._failovers_pending += 1
+                self._rehomed_total += 1
+                self._rehomed_pending.add(str(dev_id))
+            self._record_health(dev_id, rehomed=1)
+            self._send_contribution(key, dev_id, meta, delta, v,
+                                    rehomed=True)
+
+    def _maybe_resurrect(self, aid: int) -> bool:
+        """Re-admit a dead aggregator slot once a FRESH announce (an
+        address never declared dead) appears — the restarted process has
+        an empty buffer, so re-admission cannot double-fold."""
+        with self._agg_lock:
+            if aid not in self._dead_aggs:
+                return True
+            info = self._aggs.get(aid)
+            if not info:
+                return False
+            addr = (str(info["host"]), int(info["port"]))
+            if addr in self._dead_addrs:
+                return False
+            self._dead_aggs.discard(aid)
+            self._recompute_assignment()
+            return True
+
+    def _tree_submit(self, dev_id: str, meta: dict, delta, v: int,
+                     rehomed: bool = False) -> None:
+        key = f"{int(v):08d}@{dev_id}"
+        with self._inflight_lock:
+            self._inflight[key] = (str(dev_id), dict(meta), delta,
+                                   int(v), None)
+        self._send_contribution(key, dev_id, meta, delta, v,
+                                rehomed=rehomed)
+
+    def _send_contribution(self, key: str, dev_id: str, meta: dict,
+                           delta, v: int, rehomed: bool = False) -> bool:
+        """Stream one contribution into an aggregator buffer: the
+        assigned aggregator first, then live siblings.  The accepting
+        aggregator is recorded on the in-flight entry (that is the
+        buffer a later failover re-homes FROM).  Blocks — bounded by the
+        stop event — while no aggregator is reachable; contributions are
+        never dropped at this seam.
+
+        A contribution whose HOME aggregator (the slice assignment at
+        call entry) fails mid-flight and that lands on a sibling instead
+        is a re-home too — it carries the ``rehomed`` wire flag and the
+        device is attributed in the health ledger, exactly like the
+        explicit buffer re-home after a death."""
+        home: Optional[int] = None
+        home_failed = False
+        while not self._stop.is_set():
+            with self._agg_lock:
+                assigned = self._assign.get(str(dev_id))
+                live = [a for a in sorted(self._aggs)
+                        if a not in self._dead_aggs]
+                infos = {a: dict(self._aggs[a]) for a in live}
+            if home is None:
+                home = assigned
+            order = ([assigned] if assigned in live else []) + [
+                a for a in live if a != assigned]
+            for aid in order:
+                info = infos[aid]
+                fallback = home_failed and aid != home
+                cli = None
+                try:
+                    # Short-lived connection per contribution: the pumps
+                    # stream concurrently and the tensor transport is a
+                    # strict request/reply stream per socket.
+                    cli = TensorClient(info["host"], int(info["port"]),
+                                       timeout=protocol.CONNECT_TIMEOUT,
+                                       ident=str(dev_id))
+                    hdr, _ = cli.request(
+                        {"op": "abuf", "key": key, "device": str(dev_id),
+                         "version": int(v),
+                         "rehomed": bool(rehomed or fallback),
+                         "meta": dict(meta)},
+                        delta, timeout=self.request_timeout)
+                    if hdr.get("status") != "ok":
+                        raise RuntimeError(hdr.get("error"))
+                    with self._inflight_lock:
+                        if key in self._inflight:
+                            ent = self._inflight[key]
+                            self._inflight[key] = ent[:4] + (aid,)
+                    if fallback and not rehomed:
+                        # Pump-side failover: the explicit path already
+                        # attributed before calling, this one hasn't.
+                        reg = telemetry.get_registry()
+                        reg.counter("comm.agg_failovers_total",
+                                    labels={"action": "rehome"}).inc()
+                        reg.counter("comm.agg_rehomed_total").inc()
+                        with self._inflight_lock:
+                            self._failovers_pending += 1
+                            self._rehomed_total += 1
+                            self._rehomed_pending.add(str(dev_id))
+                        self._record_health(dev_id, rehomed=1)
+                    return True
+                except Exception:
+                    if self._stop.is_set():
+                        return False
+                    if aid == home:
+                        home_failed = True
+                    self._agg_failure(aid)
+                    continue
+                finally:
+                    if cli is not None:
+                        cli.close()
+            self._stop.wait(0.2)    # nobody live: wait for a restart
+        return False
+
+    def _drain_loop(self, aid: int) -> None:
+        """One aggregator slot's drainer: long-poll ``adrain`` for the
+        next partial fold.  A drained partial's keys are acknowledged
+        (removed from ``_inflight``) IMMEDIATELY on receipt — once the
+        partial is in root memory those contributions are no longer
+        in-flight, so a subsequent aggregator death cannot re-home (and
+        double-fold) them."""
+        cli: Optional[TensorClient] = None
+        poll = max(self.agg_interval_s, 0.5)
+        while not self._stop.is_set():
+            if not self._maybe_resurrect(aid):
+                self._refresh_aggs()
+                if cli is not None:
+                    cli.close()
+                    cli = None
+                self._stop.wait(0.25)
+                continue
+            with self._agg_lock:
+                info = dict(self._aggs.get(aid) or {})
+            if not info:
+                self._refresh_aggs()
+                self._stop.wait(0.25)
+                continue
+            if cli is None:
+                try:
+                    cli = TensorClient(info["host"], int(info["port"]),
+                                       timeout=protocol.CONNECT_TIMEOUT,
+                                       ident=f"agg:{aid}")
+                    hdr, _ = cli.request({"op": "aprep", "meta": {}},
+                                         self._shapes_np,
+                                         timeout=self.request_timeout)
+                    if hdr.get("status") != "ok":
+                        raise RuntimeError(hdr.get("error"))
+                except Exception:
+                    if self._stop.is_set():
+                        return
+                    if cli is not None:
+                        cli.close()
+                        cli = None
+                    self._agg_failure(aid)
+                    self._stop.wait(0.25)
+                    continue
+            try:
+                hdr, tree = cli.request(
+                    {"op": "adrain", "interval_s": self.agg_interval_s,
+                     "timeout": poll,
+                     "slice_devices": self._slice_size(aid)},
+                    timeout=poll + self.request_timeout)
+                if hdr.get("status") != "ok":
+                    raise RuntimeError(hdr.get("error"))
+                meta = hdr.get("meta") or {}
+                if not int(meta.get("count", 0)):
+                    continue                      # idle poll
+                with self._inflight_lock:
+                    for k in meta.get("keys", []):
+                        self._inflight.pop(k, None)
+                self._partials.put((meta, tree, time.perf_counter()))
+            except Exception:
+                if self._stop.is_set():
+                    return
+                cli.close()
+                cli = None
+                self._agg_failure(aid)
+                self._stop.wait(0.1)
 
     # ------------------------------------------------------------------
     def run_aggregation(self) -> dict:
@@ -607,6 +1002,8 @@ class AsyncFederatedCoordinator:
         )
 
         reg = telemetry.get_registry()
+        if self.tree_mode:
+            return self._run_tree_aggregation()
         if self.auto_buffer:
             # Adaptive K — the telemetry made load-bearing: size the
             # buffer so a fold lands about every auto_interval_s at the
@@ -852,6 +1249,210 @@ class AsyncFederatedCoordinator:
             # conv_* learning-health keys only under --learn-observe —
             # default aggregation records stay byte-identical (pinned by
             # test).
+            rec.update(conv_sig)
+        self.history.append(rec)
+        return rec
+
+    def _run_tree_aggregation(self) -> dict:
+        """Tree mode: consume ONE partial fold from the aggregator tier
+        and apply it as one server step.
+
+        Staleness is resolved AT THE ROOT against the partial's oldest
+        constituent version: τ = version − oldest, the whole partial is
+        scaled by ``(1+τ)^-staleness_exponent`` (conservative — no
+        constituent is under-discounted), and a partial whose oldest
+        constituent is past ``max_staleness`` is discarded outright with
+        per-device attribution.  The version advances exactly once per
+        applied (or sub-quorum-discarded) partial, same as the flat
+        plane's per-buffer advance."""
+        from colearn_federated_learning_tpu.comm.aggregation import (
+            StreamingFolder,
+        )
+        from colearn_federated_learning_tpu.utils import pytrees
+
+        reg = telemetry.get_registry()
+        self._start_dispatchers()
+        t0 = time.perf_counter()
+        folder = StreamingFolder(self._shapes_np,
+                                 placement=self._placement)
+        discarded = 0
+        mass_folded = 0.0
+        mass_discarded = 0.0
+        with self.tracer.span("async.aggregate", version=self.version,
+                              tree=True) as agg_sp:
+            with self.tracer.span("collect_updates") as collect_sp:
+                stall_deadline = (time.perf_counter()
+                                  + 2.0 * self.request_timeout)
+                while True:
+                    try:
+                        meta, tree, _t_arr = self._partials.get(
+                            timeout=max(0.1, stall_deadline
+                                        - time.perf_counter()))
+                    except queue.Empty:
+                        raise RuntimeError(
+                            f"no partial fold arrived within "
+                            f"{2 * self.request_timeout:.0f}s; device "
+                            f"failures: {dict(self.failures)}") from None
+                    stall_deadline = (time.perf_counter()
+                                      + 2.0 * self.request_timeout)
+                    tau = max(0, self.version
+                              - int(meta["oldest_version"]))
+                    stale_w = (1.0 + tau) ** (-self.staleness_exponent)
+                    n = int(meta["count"])
+                    if tau > self.max_staleness:
+                        # Whole-partial discard: the root's discount is
+                        # pinned to the oldest constituent, so a partial
+                        # it would zero out is dropped with per-device
+                        # attribution (same streak/health feeds as the
+                        # flat plane's per-update discard).
+                        discarded += n
+                        self._discarded_total += n
+                        mass_discarded += stale_w * n
+                        reg.counter(
+                            "async.partials_discarded_stale").inc()
+                        reg.counter(
+                            "async.contribution_mass",
+                            labels={"outcome": "discarded"}).inc(
+                                stale_w * n)
+                        reg.histogram(
+                            "async.staleness",
+                            labels={"outcome": "discarded"}).observe(
+                                float(tau))
+                        for d in meta["devices"]:
+                            reg.counter(
+                                "async.updates_discarded_stale",
+                                labels={"device": str(d)}).inc()
+                            self._stale_streak[str(d)] = (
+                                self._stale_streak.get(str(d), 0) + 1)
+                            self._record_health(str(d),
+                                                round=self.version,
+                                                deadline_miss=1)
+                        continue
+                    break
+                contributors = [str(d) for d in meta["devices"]]
+                staleness = [max(0, self.version - int(pv))
+                             for pv in meta["versions"]]
+                weights = [float(w) * stale_w for w in meta["weights"]]
+                for d in contributors:
+                    self._stale_streak.pop(d, None)
+                scaled = None
+                if tree is not None:
+                    scaled = pytrees.tree_scale(
+                        jax.tree.map(np.asarray, tree), stale_w)
+                folder.add_partial(f"agg:{meta['agg_id']}",
+                                   float(meta["total_w"]) * stale_w,
+                                   scaled,
+                                   float(meta["loss_sum"]) * stale_w,
+                                   count=n)
+                self._folded_total += n
+                mass_folded += stale_w * n
+                reg.counter("async.partials_folded_total",
+                            labels={"agg": str(meta["agg_id"])}).inc()
+                reg.counter("comm.agg_partials_folded_total",
+                            labels={"agg": str(meta["agg_id"])}).inc()
+                reg.counter("async.contribution_mass",
+                            labels={"outcome": "folded"}).inc(
+                                stale_w * n)
+                for t_i in staleness:
+                    reg.histogram(
+                        "async.staleness",
+                        labels={"outcome": "folded"}).observe(float(t_i))
+
+            with self.tracer.span("apply_update",
+                                  version=self.version) as apply_sp:
+                mean_delta, total_w, mean_loss = folder.mean()
+                quorum = (max(1, math.ceil(self.min_cohort_fraction
+                                           * len(self.trainers)))
+                          if self.min_cohort_fraction > 0 else 0)
+                skipped_quorum = (bool(quorum)
+                                  and len(set(contributors)) < quorum)
+                if skipped_quorum:
+                    reg.counter("fed.rounds_skipped_quorum").inc()
+                    mean_delta = None
+                    mean_loss = float("nan")
+                with self._state_lock:
+                    if mean_delta is not None:
+                        self.server_state = strategies.server_update(
+                            self.server_state, mean_delta,
+                            self.config.fed)
+                    with self._version_cv:
+                        self.version += 1
+                        self._version_cv.notify_all()
+                conv_sig = None
+                if self._learn is not None:
+                    conv_sig = self._learn.observe(
+                        mean_delta, lr=self.config.fed.server_lr)
+                    if conv_sig:
+                        self._learn.export_metrics(reg, conv_sig)
+            agg_sp.attrs["folded"] = len(contributors)
+            agg_sp.attrs["discarded"] = discarded
+            agg_sp.attrs["agg_id"] = int(meta["agg_id"])
+        reg.gauge("async.pending_updates").set(
+            float(self._partials.qsize()))
+        self._export_pump_gauges(reg)
+        self.arrival.export_gauges(reg, "async.arrival_rate_per_s")
+        agg_idx = len(self.history)
+        reg.counter("async.aggregations_total").inc()
+        if self.prune_enabled:
+            self._update_pruning(agg_idx)
+        with self._inflight_lock:
+            failovers = self._failovers_pending
+            self._failovers_pending = 0
+            rehomed = sorted(self._rehomed_pending)
+            self._rehomed_pending = set()
+            rehomed_total = self._rehomed_total
+        rec = {
+            "aggregation": agg_idx,
+            "model_version": self.version,
+            "buffer_size": int(meta["buffer_k"]),
+            "staleness_mean": float(np.mean(staleness)),
+            "staleness_max": int(np.max(staleness)),
+            "discarded": discarded,
+            "contributors": contributors,
+            "train_loss": mean_loss,
+            "total_weight": total_w,
+            "agg_time_s": time.perf_counter() - t0,
+            "phase_collect_s": collect_sp.duration_s,
+            "phase_apply_s": apply_sp.duration_s,
+            # Tree-gated keys: present only in tree mode (itself
+            # non-default), so default-config records on every plane
+            # remain byte-identical.
+            "agg_id": int(meta["agg_id"]),
+            "agg_buffer_k": int(meta["buffer_k"]),
+            "agg_buffer_rate_per_s": round(
+                float(meta["arrival_rate_per_s"]), 6),
+            "oldest_version": int(meta["oldest_version"]),
+            "folded_keys": [str(k) for k in meta["keys"]],
+            "agg_failovers": failovers,
+            "rehomed_devices": rehomed,
+            "rehomed_total": rehomed_total,
+        }
+        if self.observe_records:
+            rec["mass_folded"] = round(mass_folded, 6)
+            rec["mass_discarded"] = round(mass_discarded, 6)
+            rec["arrival_rate_per_s"] = round(self.arrival.rate(), 6)
+            hs = reg.histogram("async.staleness",
+                               labels={"outcome": "folded"}).summary()
+            if hs.get("count"):
+                rec["staleness_p50"] = hs["p50"]
+                rec["staleness_p90"] = hs["p90"]
+                rec["staleness_p99"] = hs["p99"]
+        if quorum:
+            rec["skipped_quorum"] = skipped_quorum
+        if self.prune_enabled:
+            rec["pruned"] = sorted(self._pruned)
+        with self._state_lock:
+            if self._evicted_pending:
+                rec["evicted"] = self._evicted_pending
+                self._evicted_pending = []
+        reg.histogram("async.agg_time_s").observe(rec["agg_time_s"])
+        if self.accountant is not None and mean_delta is not None:
+            rec["dp_z_eff"] = self._charge_privacy(weights, contributors)
+            rec["dp_epsilon"] = self.accountant.epsilon()
+        if self.health is not None:
+            fleet = self._health_async_feed()
+            rec.update(telemetry.health_record_keys(fleet))
+        if conv_sig:
             rec.update(conv_sig)
         self.history.append(rec)
         return rec

@@ -755,7 +755,20 @@ class FederatedCoordinator:
                 aggregator as agg_lib,
             )
 
-            slices_full = agg_lib.slice_cohort(cohort, self.num_aggregators)
+            # Health-driven assignment: with a ledger attached, the
+            # cohort is ranked by straggler score before the contiguous
+            # split, so chronic stragglers concentrate in the LAST
+            # slices instead of poisoning every slice's fold cadence.
+            # Without a ledger (default) this IS slice_cohort, and the
+            # round records stay byte-identical.
+            scores = None
+            if self.health is not None:
+                fleet_now = self.health.devices()
+                if fleet_now:
+                    scores = {str(d): h.score()
+                              for d, h in fleet_now.items()}
+            slices_full = agg_lib.assign_slices(
+                cohort, self.num_aggregators, scores=scores)
             if secure:
                 cohort_of = {}
                 for sl in slices_full:

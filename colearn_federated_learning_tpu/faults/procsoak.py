@@ -700,6 +700,7 @@ def _run_async_fleet(
     enroll_timeout: float,
     timeout_s: float,
     seed: int,
+    n_aggregators: int = 0,
     fault_plan: Optional[dict] = None,
     log_fn: Optional[Callable[[dict], None]] = None,
 ) -> dict:
@@ -743,11 +744,32 @@ def _run_async_fleet(
         host, port = fleet.start_broker(timeout=30.0, extra=flight_flags)
         worker_cfg = (_async_config_flags(aggregations, n_workers, seed)
                       + flight_flags + health_flags)
+        if n_aggregators:
+            # A per-slice buffer of 1-2 devices can never clear the
+            # default distinct-contributor quorum (ceil(0.5 * workers))
+            # at the root — partials ship per SLICE, not per cohort.
+            # Last flag wins in argparse, so the override rides at the
+            # end of both role configs.
+            worker_cfg += ["--min-cohort-fraction", "0"]
         for i in range(n_workers):
             fleet.start_worker(i, worker_cfg, host, port)
+        # Aggregator tier: spawned before the coordinator so the
+        # retained announcements are on the broker before the async
+        # root's enroll_aggregators() subscribes.
+        agg_cfg = worker_cfg
+        for a in range(n_aggregators):
+            fleet.start_aggregator(a, agg_cfg, host, port)
         coord_cfg = (_async_config_flags(aggregations, n_workers, seed,
                                          checkpoint_dir=ckpt_dir)
                      + flight_flags + health_flags)
+        if n_aggregators:
+            # The 1s heartbeat deadline (default 5s) keeps failover
+            # detection well inside the post-kill runway of a short
+            # soak; the oracle gets the same value so the runs stay
+            # config-identical.
+            coord_cfg += ["--num-aggregators", str(n_aggregators),
+                          "--min-cohort-fraction", "0",
+                          "--agg-heartbeat-timeout", "1.0"]
         if fault_plan is not None:
             plan_path = os.path.join(workdir, "fault_plan.json")
             with open(plan_path, "w") as f:
@@ -815,6 +837,15 @@ def _run_async_fleet(
                         victim.send_signal(signal.SIGKILL)
                         victim.wait()
                     fleet.restart_broker()
+                elif spec.target.startswith("aggregator:"):
+                    aid = int(spec.target.split(":", 1)[1])
+                    victim = fleet.aggregators.get(aid)
+                    if victim is not None and victim.poll() is None:
+                        kill_rec["pid"] = victim.pid
+                        victim.send_signal(signal.SIGKILL)
+                        victim.wait()
+                    if spec.restart:
+                        fleet.start_aggregator(aid, agg_cfg, host, port)
                 else:
                     wid = int(spec.target.split(":", 1)[1])
                     victim = fleet.workers.get(wid)
@@ -1026,6 +1057,171 @@ def run_async_soak(
         "health_devices": len(devices),
         "fault_retries": fault_retries,
         "faults_attributed": faults_attributed,
+        "flight_missing": faulted["flight_missing"],
+        "kills": faulted["kills"],
+        "records": faulted["records"],
+        "workdir": workdir,
+    }
+
+
+def run_tree_async_soak(
+    aggregations: int = 6,
+    n_workers: int = 3,
+    buffer_size: int = 2,
+    workdir: Optional[str] = None,
+    round_timeout: float = 120.0,
+    enroll_timeout: float = 90.0,
+    timeout_s: float = 900.0,
+    kill: bool = True,
+    seed: int = 0,
+    loss_tol: float = 0.75,
+    log_fn: Optional[Callable[[dict], None]] = None,
+) -> dict:
+    """Tree-async chaos gate: buffered-async THROUGH the aggregator
+    tree, with an aggregator SIGKILLed mid-aggregation (and left dead)
+    plus a broker kill-and-rebind one aggregation later.
+
+    Two full subprocess federations, identical config and seed, both
+    running buffered-async through 2 per-slice aggregator buffers:
+
+    - **faulted** — aggregator 0 dies the moment aggregation
+      ``aggregations // 2 - 1``'s record streams (mid-aggregation:
+      dispatcher pumps in flight, its buffer part-staged) and STAYS
+      dead — the root must sticky-dead its address and re-home the
+      in-flight contributions of its slice onto aggregator 1 without
+      folding any of them twice; one aggregation later the broker is
+      SIGKILLed and rebinds its original port (worker re-enrollment
+      watchdogs + the root's announcement re-subscribe must heal);
+    - **oracle** — the same tree federation, kill-free.
+
+    Gates (``colearn chaos --tree-async``):
+
+    - *loss parity* — the faulted run's tail train loss stays within
+      ``loss_tol`` of the kill-free tree oracle's;
+    - *zero double-folds* — every dedup key in the record stream's
+      ``folded_keys`` lists is globally unique across the run: a
+      re-homed contribution folded exactly once, on exactly one
+      aggregator (``double_folds`` must be 0);
+    - *failover fired* — summed ``agg_failovers`` >= 1 with ``kill``;
+    - *re-home attribution* — every device named in a record's
+      ``rehomed_devices`` carries ``rehomed >= 1`` in the health
+      ledger: the ledger tells the operator WHO rode through the
+      failover, not just that one happened;
+    - *version monotonicity*, flight-dump coverage of every SIGKILLed
+      pid, postmortem attribution of the dead aggregator, and
+      health-ledger durability, as in the flat async soak."""
+    if aggregations < 4:
+        raise ValueError(
+            f"tree-async soak needs >= 4 aggregations so the kills land "
+            f"inside the run, got {aggregations}")
+    workdir = workdir or tempfile.mkdtemp(prefix="colearn_treeasync_")
+    os.makedirs(workdir, exist_ok=True)
+    # Kill EARLY (after ~a third of the run) so the post-kill runway is
+    # long enough for bounded-deadline detection to fire, the in-flight
+    # slice-0 contributions to re-home, and the re-homed partials to
+    # fold into later records — all before the root hits its target.
+    cut = max(1, aggregations // 3)
+    kills = ([KillSpec("aggregator:0", after_round=cut, restart=False),
+              KillSpec("broker", after_round=min(cut + 2,
+                                                 aggregations - 1))]
+             if kill else [])
+
+    faulted = _run_async_fleet(
+        aggregations=aggregations, n_workers=n_workers,
+        buffer_size=buffer_size, kills=kills,
+        workdir=os.path.join(workdir, "faulted"),
+        round_timeout=round_timeout, enroll_timeout=enroll_timeout,
+        timeout_s=timeout_s, seed=seed, n_aggregators=2,
+        fault_plan=None, log_fn=log_fn)
+    oracle = _run_async_fleet(
+        aggregations=aggregations, n_workers=n_workers,
+        buffer_size=buffer_size, kills=[],
+        workdir=os.path.join(workdir, "oracle"),
+        round_timeout=round_timeout, enroll_timeout=enroll_timeout,
+        timeout_s=timeout_s, seed=seed, n_aggregators=2,
+        fault_plan=None, log_fn=log_fn)
+
+    import math as _math
+
+    final_loss = _tail_loss(faulted["records"])
+    oracle_loss = _tail_loss(oracle["records"])
+    loss_gap = abs(final_loss - oracle_loss)
+    loss_gap_ok = _math.isfinite(loss_gap) and loss_gap <= loss_tol
+
+    # Double-fold audit: each aggregation record carries the dedup keys
+    # (``version@device``) its folded partial was built from.  A key
+    # appearing in two records means one contribution reached the model
+    # twice — the exact failure mode re-home-with-ack-on-receipt
+    # exists to prevent.  Records are deduplicated by aggregation index
+    # (LAST wins), so a resumed re-run never false-positives here.
+    seen_keys: set = set()
+    double_folds = 0
+    for rec in faulted["records"]:
+        for key in rec.get("folded_keys", []):
+            if key in seen_keys:
+                double_folds += 1
+            seen_keys.add(key)
+
+    agg_failovers = sum(int(r.get("agg_failovers", 0))
+                        for r in faulted["records"])
+    failover_fired = (not kill) or agg_failovers >= 1
+    rehomed_devices = sorted({str(d) for r in faulted["records"]
+                              for d in r.get("rehomed_devices", [])})
+
+    # Postmortem: the dead aggregator's black box must parse and the
+    # merged report must name the aggregator role for its pid.
+    from colearn_federated_learning_tpu.telemetry import flight as _flight
+
+    killed_pids = {k["pid"] for k in faulted["kills"] if "pid" in k}
+    if killed_pids:
+        dumps = _flight.load_flight_dumps(
+            os.path.join(workdir, "faulted", "flight"))
+        report = _flight.postmortem_report(dumps)
+        agg_attributed = any(
+            p.get("pid") in killed_pids
+            and str(p.get("role", "")).startswith("aggregator")
+            for p in report.get("processes", []))
+    else:
+        agg_attributed = not kill
+
+    # Health-ledger attribution of the re-home: durability first (the
+    # ledgers must parse and be non-empty), then the re-home trail —
+    # every device the record stream says was re-homed must carry a
+    # ``rehomed`` count in the merged ledger.
+    from colearn_federated_learning_tpu.telemetry import health as _health
+
+    try:
+        devices = _health.load_health(
+            os.path.join(workdir, "faulted", "health"))
+    except ValueError:
+        devices = {}
+    health_ok = bool(devices)
+    ledger_rehomed = {d for d, h in devices.items()
+                     if int(h.counts.get("rehomed", 0)) >= 1}
+    rehomed_attributed = ((not kill) or
+                          (bool(rehomed_devices)
+                           and set(rehomed_devices) <= ledger_rehomed))
+
+    return {
+        "exit_code": faulted["exit_code"],
+        "oracle_exit_code": oracle["exit_code"],
+        "aggregations_run": faulted["aggregations_run"],
+        "oracle_aggregations_run": oracle["aggregations_run"],
+        "version_monotonic": (faulted["version_monotonic"]
+                              and oracle["version_monotonic"]),
+        "final_loss": final_loss,
+        "oracle_final_loss": oracle_loss,
+        "loss_gap": loss_gap,
+        "loss_gap_ok": loss_gap_ok,
+        "double_folds": double_folds,
+        "folded_keys_total": len(seen_keys),
+        "agg_failovers": agg_failovers,
+        "failover_fired": failover_fired,
+        "rehomed_devices": rehomed_devices,
+        "rehomed_attributed": rehomed_attributed,
+        "postmortem_attributed": agg_attributed,
+        "health_ledger_ok": health_ok,
+        "health_devices": len(devices),
         "flight_missing": faulted["flight_missing"],
         "kills": faulted["kills"],
         "records": faulted["records"],

@@ -255,3 +255,78 @@ class HierarchicalLearner:
             ):
                 log_fn(rec)
         return self.history
+
+
+# ---- tree-async secure-agg groundwork (per-buffer mask cohorts) ----------
+def buffer_mask_cohorts(assignment: dict, pruned=()) -> dict:
+    """Per-buffer mask cohorts for the tree-async plane.
+
+    ``assignment`` maps device id -> aggregator id (the async root's
+    slice assignment).  Pairwise masks only cancel within a COMPLETE
+    sum, and in tree-async mode each aggregator's buffer is folded (and
+    staleness-discounted) as its own partial — so a mask pair must never
+    span two buffers.  Each buffer therefore becomes its own pairing
+    cohort, exactly the group-local math :meth:`HierarchicalLearner
+    .mask_cost_summary` prices for the edge tier.
+
+    ``pruned`` devices are excluded from the pair graph UP FRONT: a
+    pruned client is a *predicted* dropout — the root pauses its pump
+    before mask setup, it never commits a mask, and its absence costs
+    zero share recoveries.  (A *reactive* dropout — a device that masks
+    and then dies mid-buffer — costs its ``degree`` share recoveries,
+    as on the sync plane.)
+
+    Returns ``agg_id -> sorted device-id list`` (deterministic cohort
+    order: the mask PRG seeds key off pair order).
+    """
+    cut = {str(d) for d in pruned}
+    out: dict = {}
+    for dev, aid in assignment.items():
+        if str(dev) in cut:
+            continue
+        out.setdefault(aid, []).append(str(dev))
+    return {aid: sorted(devs, key=str) for aid, devs in sorted(out.items())}
+
+
+def async_mask_cost(assignment: dict, param_count: int,
+                    neighbors: int = 0, pruned=()) -> dict:
+    """Analytic secure-agg cost of the per-buffer cohort layout.
+
+    Prices what :func:`buffer_mask_cohorts` buys: per-buffer pair
+    degrees (each device's masks span only its buffer), the predicted-
+    dropout accounting (pruned devices cost ZERO recoveries because
+    they are excluded before mask commitment), and the per-buffer
+    reactive-recovery bill a mid-buffer death would cost instead."""
+    from colearn_federated_learning_tpu.privacy import dropout
+
+    cohorts = buffer_mask_cohorts(assignment, pruned=pruned)
+    active = sum(len(devs) for devs in cohorts.values())
+    per_buffer: dict = {}
+    pairs_total = 0
+    for aid, devs in cohorts.items():
+        if not devs:
+            continue
+        cost = dropout.mask_cost(
+            cohort=max(1, active), param_count=param_count,
+            neighbors=neighbors, group_size=len(devs))
+        degree = cost["pairs_per_device"]
+        per_buffer[aid] = {
+            "devices": len(devs),
+            "pairs_per_device": degree,
+            "mask_flops_per_device": cost["mask_flops_per_device"],
+            # What ONE reactive (mid-buffer) dropout in this buffer
+            # would cost: its degree's worth of share recoveries.
+            "reactive_recovery_shares": degree,
+        }
+        pairs_total += len(devs) * degree // 2
+    predicted = sum(1 for d in assignment if str(d) in
+                    {str(p) for p in pruned})
+    return {
+        "buffers": per_buffer,
+        "active_devices": active,
+        "pairs_total": pairs_total,
+        "predicted_dropouts": predicted,
+        # The headline: a predicted dropout never masked, so it costs
+        # nothing to recover from — unlike a reactive one.
+        "predicted_recovery_shares": 0,
+    }

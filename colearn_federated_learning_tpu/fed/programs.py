@@ -380,7 +380,7 @@ def _build_vmap_round(ln):
         else:
             skey = prng.sampling_key(key, round_idx)
             if ln.cohort_size < ln.num_clients:
-                sel = _rank_cohort(skey, counts, ln.cohort_size)
+                sel = rank_cohort(skey, counts, ln.cohort_size)
             else:
                 sel = jnp.arange(ln.num_clients)
         cohort_global = jnp.take(ids, sel)
@@ -427,7 +427,7 @@ def _build_mesh_round(ln):
             if ln.cohort_per_device < local_clients:
                 # This device's slice of the cohort among its REAL
                 # clients (interleaved placement spreads reals evenly).
-                sel = _rank_cohort(skey, counts_blk,
+                sel = rank_cohort(skey, counts_blk,
                                    ln.cohort_per_device)
             else:
                 sel = jnp.arange(local_clients)

@@ -715,6 +715,7 @@ class FleetSim:
         straggler_multiplier: float = 20.0,
         observe: bool = False,
         auto_interval_min: Optional[float] = None,
+        aggregators: int = 0,
         log_fn=None,
     ) -> list[dict]:
         """Buffered-asynchronous simulation (FedBuff semantics over the
@@ -752,9 +753,25 @@ class FleetSim:
         (and the stragglers' realized τ) swing with it.  ``observe`` stamps observatory keys (staleness
         tail, contribution mass, EWMA arrival rate) into records;
         implied by auto-K, off by default so default async records stay
-        byte-identical."""
+        byte-identical.
+
+        ``aggregators`` > 0 switches to the TWO-TIER tree-async plane
+        (:meth:`_fit_async_tree`): per-slice buffers with their own
+        auto-K, partials folded unscaled at the edge and staleness-
+        discounted at the root against the partial's OLDEST constituent
+        version.  Default (0) records stay byte-identical."""
         import heapq
 
+        if aggregators:
+            return self._fit_async_tree(
+                aggregations, aggregators, buffer_size,
+                staleness_exponent=staleness_exponent,
+                max_staleness=max_staleness, prune_after=prune_after,
+                probation=probation,
+                straggler_fraction=straggler_fraction,
+                straggler_multiplier=straggler_multiplier,
+                observe=observe, auto_interval_min=auto_interval_min,
+                log_fn=log_fn)
         if self._traffic is None:
             raise NotImplementedError(
                 "fit_async needs the traffic model: build the sim with "
@@ -987,6 +1004,347 @@ class FleetSim:
                 rec["pruned_total"] = pruned_total
             if conv_sig:
                 # conv_* learning-health keys only under --learn-observe.
+                rec.update(conv_sig)
+            reg.counter("fleetsim.async_aggregations_total").inc()
+            self.history.append(rec)
+            if log_fn is not None:
+                log_fn(rec)
+        reg.gauge("fleetsim.async_sim_minutes").set(now)
+        reg.histogram("fleetsim.round_time_s").observe(
+            time.perf_counter() - start)
+        return self.history
+
+    def _fit_async_tree(
+        self,
+        aggregations: int,
+        aggregators: int,
+        buffer_size,
+        *,
+        staleness_exponent: float,
+        max_staleness: int,
+        prune_after: int,
+        probation: int,
+        straggler_fraction: float,
+        straggler_multiplier: float,
+        observe: bool,
+        auto_interval_min: Optional[float],
+        log_fn,
+    ) -> list[dict]:
+        """Two-tier buffered-async: per-slice aggregator buffers over the
+        same virtual event clock as :meth:`fit_async`.
+
+        Devices are sliced across ``aggregators`` by SERVICE TIME
+        (sorted, contiguous divmod) — the health-driven assignment the
+        socket plane computes from ledger scores, which concentrates
+        chronic stragglers in the last slice so their deep buffer
+        absorbs the tail instead of every buffer carrying a piece of it.
+        Each slice runs its own :class:`~.telemetry.ArrivalEstimator`
+        and auto-K buffer (slew-limited to ±50% per retune, the same
+        band as the flat auto-K): one partial per ``auto_interval_min``
+        of that slice's measured arrival rate.
+
+        A full slice buffer ships a PARTIAL: its version groups fold
+        UNSCALED at the edge (the aggregator cannot know the root's
+        version when contributions keep arriving), and the root scales
+        the whole partial by ``(1 + tau)^-exp`` where ``tau`` is
+        measured against the partial's OLDEST constituent version —
+        exactly the socket tree-async plane's semantics.  A partial
+        whose oldest constituent exceeds ``max_staleness`` is discarded
+        WHOLE (``fleetsim.async_partials_discarded_total``); one root
+        aggregation applies one surviving partial.
+
+        Per-slice fold-cadence tracking: ``agg_fold_tracking_min`` is
+        the worst slice's ``min(r, 1/r)`` for ``r = realized mean ship
+        interval / target interval`` — 1.0 when every buffer folds on
+        cadence, sagging toward 0 when a slice folds far too rarely
+        (starved) OR far too often (K undersized).  The
+        ``fleet_tree_async`` bench sentinel holds the floor."""
+        import heapq
+
+        if self._traffic is None:
+            raise NotImplementedError(
+                "fit_async needs the traffic model: build the sim with "
+                "FleetSim.from_population")
+        n_dev = self.num_devices
+        if aggregators < 2:
+            raise ValueError(
+                f"tree-async needs >= 2 aggregators, got {aggregators}")
+        if aggregators > n_dev:
+            raise ValueError(
+                f"{aggregators} aggregators exceed the {n_dev}-device "
+                "fleet — a slice would be empty")
+        warm = 8 if isinstance(buffer_size, str) else int(buffer_size)
+        if not isinstance(buffer_size, str) and buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        observe = True      # tree mode is always auto-K (implies observe)
+        spec = self._traffic.spec
+        if auto_interval_min is None:
+            auto_interval_min = spec.round_minutes
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.config.run.seed, 0xA51C]))
+        service = spec.round_minutes * rng.lognormal(
+            0.0, 0.5, size=n_dev)
+        n_slow = int(round(straggler_fraction * n_dev))
+        slow_ids = rng.permutation(n_dev)[:n_slow]
+        service[slow_ids] *= straggler_multiplier
+        reg = telemetry.get_registry()
+
+        # Service-time-sorted contiguous slices: slice 0 gets the fast
+        # devices, the last slice the stragglers (deep buffer).
+        order = np.argsort(service, kind="stable")
+        base, extra = divmod(n_dev, aggregators)
+        slice_of = np.empty(n_dev, np.int64)
+        slice_ids: list[np.ndarray] = []
+        pos = 0
+        for a in range(aggregators):
+            size = base + (1 if a < extra else 0)
+            members = order[pos:pos + size]
+            slice_of[members] = a
+            slice_ids.append(members)
+            pos += size
+
+        ests = [telemetry.ArrivalEstimator() for _ in range(aggregators)]
+        ks = [max(1, min(warm, len(slice_ids[a]), self.chunk_size))
+              for a in range(aggregators)]
+        buffers: list[list[tuple[int, int]]] = [[] for _ in
+                                                range(aggregators)]
+        ship_times: list[list[float]] = [[] for _ in range(aggregators)]
+        partials_folded = [0] * aggregators
+
+        version = 0
+        ring: dict[int, object] = {0: self.server_state.params}
+        heap: list = []          # (t_done, seq, device_id, version)
+        seq = 0
+        all_ids = np.arange(n_dev, dtype=np.int64)
+        wait0 = self._async_arrival_wait(rng, all_ids, 0.0)
+        for d in range(n_dev):
+            heapq.heappush(heap, (wait0[d] + service[d], seq, d, 0))
+            seq += 1
+        now = 0.0
+        arrivals = 0
+        wasted = 0
+        stale_streak: dict[int, int] = {}
+        pruned: dict[int, int] = {}   # device -> aggregation to re-admit
+        pruned_total = 0
+        base_len = len(self.history)
+        start = time.perf_counter()
+
+        def redispatch(d: int, t: float) -> None:
+            nonlocal seq
+            wait = float(self._async_arrival_wait(
+                rng, np.asarray([d], np.int64), t)[0])
+            heapq.heappush(heap, (t + wait + service[d], seq, d, version))
+            seq += 1
+
+        def retune(a: int) -> None:
+            # Auto-K on the slice's OWN arrival rate, slew-limited so
+            # the resize trails the diurnal swing instead of chasing it.
+            cur = ks[a]
+            active = sum(1 for d in slice_ids[a] if int(d) not in pruned)
+            hi = max(1, min(self.chunk_size, active))
+            k = ests[a].recommend_buffer(auto_interval_min, lo=1, hi=hi,
+                                         current=cur)
+            k = int(np.clip(k, max(1, cur // 2), max(2, cur * 3 // 2)))
+            k = max(1, min(k, hi))
+            if k != cur:
+                reg.counter("fleetsim.async_buffer_resizes_total").inc()
+            ks[a] = k
+
+        def tracking_min() -> float:
+            # Per-slice cadence tracking: realized mean ship interval vs
+            # the interval auto-K can actually DELIVER for this slice —
+            # the target clipped into the achievable band [1/rate,
+            # hi/rate] (K is an integer in [1, hi]; a slice whose
+            # arrival rate over- or under-shoots the band is capacity-
+            # limited, not mistracking).  Trailing window (last 5
+            # intervals) so the warm-start transient ages out; ``min(r,
+            # 1/r)`` sags on a buffer folding far off its own band —
+            # starved, stuck, or thrashing — which is what the
+            # ``fleet_tree_async`` sentinel floors.
+            vals = []
+            for a in range(aggregators):
+                rate = ests[a].rate()
+                active = sum(1 for d in slice_ids[a]
+                             if int(d) not in pruned)
+                hi = max(1, min(self.chunk_size, active))
+                t_eff = auto_interval_min
+                if rate > 0:
+                    t_eff = float(np.clip(auto_interval_min,
+                                          1.0 / rate, hi / rate))
+                ts = ship_times[a][-6:]
+                if len(ts) >= 2:
+                    realized = (ts[-1] - ts[0]) / (len(ts) - 1)
+                    r = realized / max(t_eff, 1e-9)
+                    vals.append(min(r, 1.0 / r) if r > 0 else 0.0)
+                elif len(ts) == 1:
+                    vals.append(1.0)   # one ship — no interval yet
+                else:
+                    # Never shipped: on cadence only while younger than
+                    # two achievable intervals.
+                    vals.append(1.0 if now <= 2 * t_eff else 0.0)
+            return round(min(vals), 6)
+
+        for agg in range(aggregations):
+            t0 = time.perf_counter()
+            for d in [d for d, until in pruned.items() if until <= agg]:
+                del pruned[d]
+                stale_streak.pop(d, None)
+                redispatch(d, now)
+            discarded_partials = 0
+            mass_folded = 0.0
+            mass_discarded = 0.0
+            while True:
+                # Pump arrivals into slice buffers until one fills.
+                while True:
+                    t_done, _, d, v = heapq.heappop(heap)
+                    now = max(now, t_done)
+                    arrivals += 1
+                    a = int(slice_of[d])
+                    ests[a].observe(str(d), now=now)
+                    buffers[a].append((int(d), int(v)))
+                    if len(buffers[a]) >= ks[a]:
+                        break
+                batch, buffers[a] = buffers[a], []
+                k_ship = ks[a]
+                ship_times[a].append(now)
+                retune(a)
+                oldest = min(v for _, v in batch)
+                tau = version - oldest
+                s_w = float((1.0 + tau) ** -staleness_exponent)
+                if tau > max_staleness:
+                    # Whole-partial discard: the root cannot unpick one
+                    # constituent out of a pre-folded sum.
+                    discarded_partials += 1
+                    wasted += len(batch)
+                    reg.counter(
+                        "fleetsim.async_partials_discarded_total").inc()
+                    for dd, dv in batch:
+                        dtau = version - dv
+                        dw = float((1.0 + dtau) ** -staleness_exponent)
+                        mass_discarded += dw
+                        reg.counter(
+                            "fleetsim.async_contribution_mass",
+                            labels={"outcome": "discarded"}).inc(dw)
+                        reg.histogram(
+                            "fleetsim.async_staleness",
+                            labels={"outcome": "discarded"}).observe(
+                                float(dtau))
+                        reg.counter(
+                            "fleetsim.async_updates_discarded_total").inc()
+                        # Prune streaks accrue only to devices whose OWN
+                        # contribution was too stale — fresh constituents
+                        # batched with a stale one are collateral of the
+                        # whole-partial discard, not stragglers.
+                        if dtau > max_staleness:
+                            streak = stale_streak.get(dd, 0) + 1
+                            stale_streak[dd] = streak
+                        else:
+                            streak = 0
+                        active = sum(1 for x in slice_ids[a]
+                                     if int(x) not in pruned)
+                        if (prune_after > 0 and streak >= prune_after
+                                and active > 1):
+                            pruned[dd] = agg + probation
+                            pruned_total += 1
+                            reg.counter(
+                                "fleetsim.async_devices_pruned_total"
+                            ).inc()
+                        else:
+                            redispatch(dd, now)
+                    continue
+                break
+
+            # Fold the partial: version groups UNSCALED at the edge,
+            # then one root-side staleness discount for the whole
+            # partial keyed off its oldest constituent.
+            stalenesses = [version - v for _, v in batch]
+            acc = self._zero_acc()
+            for v in sorted({v for _, v in batch}):
+                ids = np.asarray([dd for dd, dv in batch if dv == v],
+                                 np.int64)
+                padded = np.zeros(self.chunk_size, np.int64)
+                padded[:ids.shape[0]] = ids
+                keep = np.zeros(self.chunk_size, bool)
+                keep[:ids.shape[0]] = True
+                budgets = np.zeros(self.chunk_size, np.int32)
+                budgets[:ids.shape[0]] = self._budget_fn(ids).astype(
+                    np.int32)
+                cx, cy, cc = self._shard_fn(padded)
+                part = self._chunk_fn(
+                    self.base_key, ring[v], cx, cy, cc, padded,
+                    jnp.asarray(v, jnp.int32), budgets, keep)
+                acc = self._fold_fn(acc, part)
+            wsum, total_w, loss_sum, n_comp = acc
+            acc = (pytrees.tree_scale(wsum, s_w), total_w * s_w,
+                   loss_sum * s_w, n_comp)
+            self.server_state, mean_delta, metrics = self._finish_fn(
+                self.server_state, *acc)
+            out = {k: float(x) for k, x in jax.device_get(metrics).items()}
+            conv_sig = None
+            if self._learn is not None:
+                conv_sig = self._learn.observe(
+                    mean_delta, lr=self.config.fed.server_lr)
+                if conv_sig:
+                    self._learn.export_metrics(reg, conv_sig)
+            for dd, dv in batch:
+                stale_streak.pop(dd, None)
+                dtau = version - dv
+                dw = float((1.0 + dtau) ** -staleness_exponent)
+                mass_folded += dw
+                reg.counter("fleetsim.async_contribution_mass",
+                            labels={"outcome": "folded"}).inc(dw)
+                reg.histogram("fleetsim.async_staleness",
+                              labels={"outcome": "folded"}).observe(
+                                  float(dtau))
+            partials_folded[a] += 1
+            reg.counter("fleetsim.async_partials_folded_total").inc()
+            version += 1
+            ring[version] = self.server_state.params
+            for v in [v for v in ring if v < version - max_staleness]:
+                del ring[v]
+            for dd, _ in batch:
+                redispatch(dd, now)
+
+            rec = {
+                "aggregation": base_len + agg,
+                "model_version": version,
+                "buffer_size": k_ship,
+                "staleness_mean": float(np.mean(stalenesses)),
+                "staleness_max": int(np.max(stalenesses)),
+                "discarded": discarded_partials,
+                "contributors": len(batch),
+                "train_loss": out["train_loss"],
+                "total_weight": out["total_weight"],
+                "sim_time_min": now,
+                "arrival_rate_per_min": arrivals / max(now, 1e-9),
+                "agg_rate_per_min": (agg + 1) / max(now, 1e-9),
+                "wasted_updates_total": wasted,
+                "agg_time_s": time.perf_counter() - t0,
+                # Tree keys (absent from flat async records).
+                "aggregators": aggregators,
+                "agg_id": int(a),
+                "agg_buffer_k": int(ks[a]),
+                "agg_fold_tracking_min": tracking_min(),
+            }
+            reg.gauge("fleetsim.async_buffer_size").set(ks[a])
+            reg.gauge("fleetsim.async_arrival_rate_per_min").set(
+                sum(e.rate() for e in ests))
+            if observe:
+                rec["arrival_rate_ewma_per_min"] = round(
+                    sum(e.rate() for e in ests), 6)
+                rec["mass_folded"] = round(mass_folded, 6)
+                rec["mass_discarded"] = round(mass_discarded, 6)
+                hs = reg.histogram(
+                    "fleetsim.async_staleness",
+                    labels={"outcome": "folded"}).summary()
+                if hs.get("count"):
+                    rec["staleness_p50"] = hs["p50"]
+                    rec["staleness_p90"] = hs["p90"]
+                    rec["staleness_p99"] = hs["p99"]
+            if prune_after > 0:
+                rec["pruned"] = len(pruned)
+                rec["pruned_total"] = pruned_total
+            if conv_sig:
                 rec.update(conv_sig)
             reg.counter("fleetsim.async_aggregations_total").inc()
             self.history.append(rec)

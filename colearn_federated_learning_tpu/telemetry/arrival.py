@@ -105,6 +105,16 @@ class ArrivalEstimator:
         with self._lock:
             return {d: e.rate for d, e in self._devices.items()}
 
+    def group_rate(self, device_ids) -> float:
+        """Summed per-device rate over one slice of the fleet — the
+        arrival rate an aggregator owning exactly ``device_ids`` would
+        see.  Devices the estimator has not warmed up on contribute 0.0
+        (same cold semantics as :meth:`device_rate`)."""
+        with self._lock:
+            return sum(
+                self._devices[str(d)].rate for d in device_ids
+                if str(d) in self._devices)
+
     def recommend_buffer(self, target_interval: float, *, lo: int = 1,
                          hi: int = 1 << 30,
                          current: Optional[int] = None) -> int:

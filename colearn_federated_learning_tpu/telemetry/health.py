@@ -38,8 +38,14 @@ from colearn_federated_learning_tpu.telemetry import registry as _metrics
 # a health event, same as a straggler); it rides the same
 # forward-compatible zero-default path and is deliberately NOT a rendered
 # column (`colearn health` output is contract-stable).
+# ``rehomed`` is the aggregator-tree failover feed: the device's in-flight
+# contribution was re-sent to a sibling aggregator after its assigned one
+# died.  It attributes infrastructure faults, not device behavior, so it
+# carries ZERO weight in score() and (like norm_anomaly) is not a
+# rendered column.
 COUNT_FIELDS = ("deadline_miss", "retry", "corrupt_frame", "eviction",
-                "secure_dropout", "prune", "pump_stall", "norm_anomaly")
+                "secure_dropout", "prune", "pump_stall", "norm_anomaly",
+                "rehomed")
 
 _EWMA_ALPHA = 0.2
 _MAX_SAMPLES = 256

@@ -149,6 +149,11 @@ def validate_robustness(config: "ExperimentConfig") -> None:
             "agg_heartbeat_timeout must be positive, got "
             f"{run.agg_heartbeat_timeout}"
         )
+    if run.agg_buffer_interval_s <= 0:
+        raise ValueError(
+            "agg_buffer_interval_s must be positive, got "
+            f"{run.agg_buffer_interval_s}"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -345,6 +350,10 @@ class RunConfig:
     # heartbeat is older than this is treated as dead at dispatch and its
     # slices re-home to live siblings.
     agg_heartbeat_timeout: float = 5.0
+    # Tree-async per-slice fold cadence target (seconds): each buffered
+    # aggregator auto-sizes its fold threshold K so one partial ships
+    # upstream about this often at the slice's observed arrival rate.
+    agg_buffer_interval_s: float = 2.0
     # Per-device health ledger (telemetry/health.py): directory the
     # coordinator/aggregator/fleetsim planes write durable straggler
     # attribution into.  None = plane off, no extra I/O, and round

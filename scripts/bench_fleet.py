@@ -243,6 +243,34 @@ DRIFT_ROW_SCHEMA = {
     "bench_wall_s": float,
 }
 
+# Tree-async rows (--tree-async-sweep): buffered-async THROUGH the
+# per-slice aggregator tree, 1k -> 1M devices.  Small fleets run
+# MEASURED (fleetsim._fit_async_tree on a real seeded fleet: per-slice
+# auto-K buffers, edge-folded partials root-discounted against the
+# oldest constituent); large fleets price the same model ANALYTICALLY
+# (per-slice arrival rates, integer-K cadence, fixed-point waste) so
+# the 1M row never materializes a 1M fleet.  ``fold_tracking_min`` is
+# the worst slice's cadence tracking against its achievable band — the
+# sentinel floors it at 0.75 with >= 2 aggregators.
+# ``rehome_slice_frac`` prices failover: the in-flight mass share one
+# dead aggregator re-homes onto its siblings (1/aggregators).
+TREE_ASYNC_ROW_SCHEMA = {
+    "bench": str,
+    "mode": str,                  # "measured" | "analytic"
+    "devices": int,
+    "aggregators": int,
+    "target_interval_min": float,
+    "max_staleness": int,
+    "arrival_rate_per_min": float,
+    "agg_rate_per_min": float,
+    "buffer_k_mean": float,
+    "fold_tracking_min": float,
+    "staleness_mean": float,
+    "waste_fraction": float,
+    "rehome_slice_frac": float,
+    "bench_wall_s": float,
+}
+
 SCHEMAS = {
     "fleet_round": ROW_SCHEMA,
     "fleet_learn_drift": DRIFT_ROW_SCHEMA,
@@ -252,6 +280,7 @@ SCHEMAS = {
     "fleet_async": ASYNC_ROW_SCHEMA,
     "fleet_async_prune": ASYNC_PRUNE_ROW_SCHEMA,
     "fleet_async_autok": ASYNC_AUTOK_ROW_SCHEMA,
+    "fleet_tree_async": TREE_ASYNC_ROW_SCHEMA,
 }
 
 
@@ -738,6 +767,133 @@ def async_autok_point(*, devices: int = 64, aggregations: int = 120,
     }
 
 
+def tree_async_measured_point(*, devices: int = 1000, aggregators: int = 2,
+                              aggregations: int = 24,
+                              max_staleness: int = 50,
+                              prune_after: int = 2,
+                              target_interval_min: float = 10.0,
+                              chunk: int = 256, seed: int = 0) -> dict:
+    """One MEASURED tree-async row: fleetsim's two-tier fit_async on a
+    real seeded fleet — service-time-sorted slices, per-slice auto-K
+    buffers, edge-folded partials staleness-discounted at the root
+    against the oldest constituent.  Pruning is armed (the tree plane's
+    predicted-dropout policy): chronic stragglers whose own
+    contributions repeatedly exceed ``max_staleness`` stop being
+    re-dispatched, which is what keeps the straggler slice's fold
+    cadence in band."""
+    from colearn_federated_learning_tpu import fleetsim
+    from colearn_federated_learning_tpu.utils.config import (
+        ExperimentConfig, FedConfig, ModelConfig, RunConfig)
+
+    t0 = time.time()
+    spec = fleetsim.PopulationSpec(num_devices=devices, num_classes=10,
+                                   feature_dim=32, shard_capacity=16,
+                                   label_skew=0.7, seed=seed)
+    population = fleetsim.DevicePopulation(spec)
+    traffic = fleetsim.TrafficModel(fleetsim.TrafficSpec(seed=seed),
+                                    spec.num_devices)
+    config = ExperimentConfig(
+        model=ModelConfig(name="mlp", num_classes=10, hidden_dim=64,
+                          depth=2),
+        fed=FedConfig(strategy="fedavg", local_steps=2, batch_size=16,
+                      lr=0.05),
+        run=RunConfig(name="bench-tree-async", seed=seed))
+    sim = fleetsim.FleetSim.from_population(
+        config, population, traffic, cohort_size=chunk, chunk_size=chunk)
+    hist = sim.fit_async(aggregations, buffer_size="auto",
+                         max_staleness=max_staleness,
+                         prune_after=prune_after,
+                         auto_interval_min=target_interval_min,
+                         aggregators=aggregators)
+    last = hist[-1]
+    arrived = last["arrival_rate_per_min"] * last["sim_time_min"]
+    return {
+        "bench": "fleet_tree_async",
+        "mode": "measured",
+        "devices": devices,
+        "aggregators": aggregators,
+        "target_interval_min": target_interval_min,
+        "max_staleness": max_staleness,
+        "arrival_rate_per_min": round(last["arrival_rate_per_min"], 4),
+        "agg_rate_per_min": round(last["agg_rate_per_min"], 6),
+        "buffer_k_mean": round(
+            sum(r["agg_buffer_k"] for r in hist) / len(hist), 3),
+        "fold_tracking_min": round(last["agg_fold_tracking_min"], 4),
+        "staleness_mean": round(
+            sum(r["staleness_mean"] for r in hist) / len(hist), 3),
+        "waste_fraction": round(
+            last["wasted_updates_total"] / max(arrived, 1e-9), 4),
+        "rehome_slice_frac": round(1.0 / aggregators, 4),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
+def tree_async_analytic_point(devices: int, aggregators: int, *,
+                              rate_per_device_hr: float = 2.0,
+                              service_mean_min: float = 10.0,
+                              straggler_fraction: float = 0.05,
+                              straggler_multiplier: float = 20.0,
+                              target_interval_min: float = 10.0,
+                              max_staleness: int = 32,
+                              chunk: int = 4096, seed: int = 0,
+                              samples: int = 65536) -> dict:
+    """One ANALYTIC tree-async row at fleet scale: the same arrival /
+    service model as :func:`async_point`, sliced across ``aggregators``
+    per-slice buffers.  Each slice's integer K = clip(rate x target, 1,
+    chunk) sets its realized fold cadence; tracking compares that
+    cadence to the slice's achievable band (a capacity-clipped K is a
+    capacity limit, not mistracking — same definition as the measured
+    rows).  The root applies one partial per ship, so the version rate
+    is the summed ship rate, and per-contribution staleness (completion
+    window x version rate) feeds the fixed-point waste estimate exactly
+    as on the flat plane."""
+    import numpy as np
+
+    t0 = time.time()
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0xA51C]))
+    rate_per_min = rate_per_device_hr / 60.0
+    wait = rng.exponential(1.0 / rate_per_min, size=samples)
+    service = service_mean_min * rng.lognormal(0.0, 0.5, size=samples)
+    n_slow = int(round(straggler_fraction * samples))
+    slow = rng.permutation(samples)[:n_slow]
+    service[slow] *= straggler_multiplier
+    window = wait + service
+
+    arrival_rate = devices * rate_per_min
+    rate_slice = arrival_rate / aggregators
+    k = int(np.clip(round(rate_slice * target_interval_min), 1, chunk))
+    t_real = k / rate_slice
+    t_eff = float(np.clip(target_interval_min, 1.0 / rate_slice,
+                          chunk / rate_slice))
+    r = t_real / max(t_eff, 1e-9)
+    tracking = min(r, 1.0 / r) if r > 0 else 0.0
+    # One root application per shipped partial: version rate is the
+    # summed per-slice ship rate.
+    version_rate = aggregators / t_real
+    waste = 0.0
+    for _ in range(32):
+        waste = float(np.mean(window * version_rate > max_staleness))
+        version_rate = (aggregators / t_real) * (1.0 - waste)
+    staleness_mean = float(np.mean(
+        np.minimum(window * version_rate, max_staleness)))
+    return {
+        "bench": "fleet_tree_async",
+        "mode": "analytic",
+        "devices": devices,
+        "aggregators": aggregators,
+        "target_interval_min": target_interval_min,
+        "max_staleness": max_staleness,
+        "arrival_rate_per_min": round(arrival_rate, 4),
+        "agg_rate_per_min": round(version_rate, 6),
+        "buffer_k_mean": float(k),
+        "fold_tracking_min": round(tracking, 4),
+        "staleness_mean": round(staleness_mean, 3),
+        "waste_fraction": round(waste, 4),
+        "rehome_slice_frac": round(1.0 / aggregators, 4),
+        "bench_wall_s": round(time.time() - t0, 4),
+    }
+
+
 def drift_point(*, devices: int = 64, rounds: int = 10,
                 label_skew_noniid: float = 0.9,
                 label_skew_iid: float = 0.0, seed: int = 0) -> dict:
@@ -894,6 +1050,17 @@ def main(argv=None) -> int:
     ap.add_argument("--async-devices", default="1000,10000,100000,1000000",
                     help="comma-separated fleet sizes for the async "
                          "throughput sweep")
+    ap.add_argument("--tree-async-sweep", action="store_true",
+                    help="append fleet_tree_async rows over "
+                         "--tree-async-devices: buffered-async through "
+                         "per-slice aggregator buffers — small fleets "
+                         "MEASURED (fleetsim two-tier fit_async), large "
+                         "fleets analytic (same arrival/service model); "
+                         "fold_tracking_min is the sentinel column")
+    ap.add_argument("--tree-async-devices",
+                    default="1000,10000,100000,1000000",
+                    help="comma-separated fleet sizes for the tree-"
+                         "async sweep (<= 2000 devices run measured)")
     ap.add_argument("--drift-sweep", action="store_true",
                     help="append ONE measured fleet_learn_drift row: "
                          "conv_cohort_skew on the same seeded fleet with "
@@ -943,6 +1110,22 @@ def main(argv=None) -> int:
         row = async_autok_point(seed=args.seed)
         rows.append(row)
         print(json.dumps(row))
+
+    if args.tree_async_sweep:
+        import math
+
+        for n in (int(x) for x in args.tree_async_devices.split(",") if x):
+            # Fan-in grows with scale: 2 aggregators at 1k doubling per
+            # decade to 16 at 1M (the ingest sweep's sizing).
+            aggs = int(min(16, max(2, 2 ** (int(math.log10(max(n, 10)))
+                                            - 2))))
+            if n <= 2000:
+                row = tree_async_measured_point(
+                    devices=n, aggregators=aggs, seed=args.seed)
+            else:
+                row = tree_async_analytic_point(n, aggs, seed=args.seed)
+            rows.append(row)
+            print(json.dumps(row))
 
     if args.drift_sweep:
         row = drift_point(seed=args.seed)

@@ -9,8 +9,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from colearn_federated_learning_tpu.utils.jax_compat import shard_map
 
 from colearn_federated_learning_tpu.parallel import factor_devices, make_mesh
 from colearn_federated_learning_tpu.parallel.ring import (

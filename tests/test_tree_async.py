@@ -1,0 +1,246 @@
+"""Buffered-async aggregator tree: health-driven slice assignment,
+bitwise parity of the per-aggregator partial fold against the flat
+async fold (dense, topk8 and LoRA-factor uplinks), re-home dedup
+(double-fold-free by construction), the tree-gated record keys, the
+per-buffer secure-agg mask cohorts, and the two-tier fleetsim path —
+including the pin that aggregators=0 records stay byte-identical."""
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.analysis import metric_catalog
+from colearn_federated_learning_tpu.comm.aggregation import StreamingFolder
+from colearn_federated_learning_tpu.comm.aggregator import (
+    assign_slices,
+    slice_cohort,
+)
+from colearn_federated_learning_tpu.fed import compression, hierarchical, lora
+from colearn_federated_learning_tpu.utils import pytrees
+
+from tests.test_fleetsim import make_fleet
+from tests.test_uplink_fastpath import _params, _tree_bytes
+
+
+# ------------------------------------------- health-driven assignment ----
+def test_assign_slices_default_degrades_to_divmod():
+    cohort = [str(i) for i in range(11)]
+    for n in (1, 2, 3, 5):
+        assert assign_slices(cohort, n) == slice_cohort(cohort, n)
+        # All-equal scores are indistinguishable from no ledger at all:
+        # the stable sort preserves cohort order exactly.
+        uniform = {c: 0.25 for c in cohort}
+        assert assign_slices(cohort, n, uniform) == slice_cohort(cohort, n)
+
+
+def test_assign_slices_concentrates_stragglers_in_last_slice():
+    cohort = [str(i) for i in range(12)]
+    scores = {c: 0.0 for c in cohort}
+    stragglers = {"1", "4", "9"}
+    for s in stragglers:
+        scores[s] = 5.0
+    layout = assign_slices(cohort, 4, scores)
+    # Same slice sizes as the contiguous layout, same cohort multiset.
+    assert [len(sl) for sl in layout] == [3, 3, 3, 3]
+    assert sorted(c for sl in layout for c in sl) == sorted(cohort)
+    # Every straggler lands in the LAST slice; the healthy slices are
+    # straggler-free, so their buffers keep their fold cadence.
+    assert set(layout[-1]) == stragglers
+    for sl in layout[:-1]:
+        assert not (set(sl) & stragglers)
+    # Device tuples (sync-plane cohort entries) key by their id field.
+    tuples = [(i, "h", 9000 + i) for i in range(6)]
+    tl = assign_slices(tuples, 2, {"2": 9.0, "5": 9.0})
+    assert {d[0] for d in tl[-1]} >= {2, 5}
+
+
+# ---------------------------------------------- partial-fold parity ------
+def _async_updates(scheme, n=6):
+    """n (meta, wire) contributions for one async dispatch version."""
+    shapes = _params()
+    out = []
+    for i in range(n):
+        rng = np.random.default_rng(300 + i)
+        d = jax.tree.map(
+            lambda w: rng.standard_normal(w.shape).astype(np.float32),
+            shapes)
+        meta = {"client_id": str(i), "weight": 1.0 + 0.125 * i,
+                "mean_loss": 0.4 + 0.05 * i}
+        if scheme == "dense":
+            wire = d
+        else:
+            wire, cmeta = compression.compress_delta(d, scheme,
+                                                     topk_fraction=0.1)
+            meta.update(cmeta)
+        out.append((meta, wire))
+    return shapes, out
+
+
+def _tree_vs_flat(shapes, updates, n_agg):
+    """Fold ``updates`` once flat (slice-blocked) and once through the
+    tree (per-aggregator StreamingFolder partials combined at the root
+    via add_partial) and return both root folders."""
+    order = [m["client_id"] for m, _ in updates]
+    layout = slice_cohort(order, n_agg)
+
+    flat = StreamingFolder(shapes, order=order, slices=layout)
+    for meta, wire in updates:
+        flat.add(dict(meta), jax.tree.map(np.copy, wire))
+    flat.finalize()
+
+    staged = {m["client_id"]: (m, w) for m, w in updates}
+    root = StreamingFolder(
+        shapes, order=[f"agg:{i}" for i in range(n_agg)])
+    for i, sl in enumerate(layout):
+        leaf = StreamingFolder(shapes, order=list(sl))
+        for cid in sl:
+            meta, wire = staged[cid]
+            leaf.add(dict(meta), jax.tree.map(np.copy, wire))
+        leaf.finalize()
+        root.add_partial(f"agg:{i}", leaf.total_w, leaf.wsum,
+                         leaf.loss_sum, count=leaf.count)
+    root.finalize()
+    return flat, root
+
+
+@pytest.mark.parametrize("n_agg", [2, 3])
+@pytest.mark.parametrize("scheme", ["dense", "topk", "topk8"])
+def test_partial_fold_at_aggregator_bitwise_vs_flat(scheme, n_agg):
+    shapes, updates = _async_updates(scheme)
+    flat, root = _tree_vs_flat(shapes, updates, n_agg)
+    assert root.total_w == flat.total_w
+    assert root.loss_sum == flat.loss_sum
+    assert _tree_bytes(root.wsum) == _tree_bytes(flat.wsum)
+    # tau = 0 at the root: (1 + 0)^-0.5 == 1.0 exactly, and the IEEE
+    # multiply by 1.0 is the identity — so a fresh partial's staleness
+    # discount cannot perturb the parity above.
+    scaled = pytrees.tree_scale(root.wsum, (1.0 + 0) ** -0.5)
+    assert _tree_bytes(scaled) == _tree_bytes(root.wsum)
+
+
+def test_partial_fold_lora_factor_trees_bitwise():
+    """The rank-r uplink folds factor trees, not model trees — the tree
+    combine must be bitwise on those too (same shapes through the
+    aggregator tier and the root)."""
+    template = lora.init_factors(_params(), 4, model_name="bert")
+    assert jax.tree.leaves(template), "factor template matched no leaves"
+    shapes = jax.tree.map(np.asarray, template)
+    updates = []
+    for i in range(5):
+        rng = np.random.default_rng(70 + i)
+        f = jax.tree.map(
+            lambda w: rng.standard_normal(w.shape).astype(np.float32),
+            shapes)
+        updates.append(({"client_id": str(i), "weight": 1.0 + 0.5 * i,
+                         "mean_loss": 0.3}, f))
+    flat, root = _tree_vs_flat(shapes, updates, 2)
+    assert root.total_w == flat.total_w
+    assert _tree_bytes(root.wsum) == _tree_bytes(flat.wsum)
+
+
+# -------------------------------------------------- re-home dedup --------
+def test_rehome_dedup_folds_once():
+    """A contribution re-homed to a sibling arrives under the same dedup
+    key ``version@device``; the buffer discards the staged copy before
+    re-staging, so the fold stays single-copy — count, weight and bytes
+    all match a folder that saw the update exactly once."""
+    shapes, updates = _async_updates("dense", n=3)
+    meta, wire = updates[0]
+    key = f"{7:08d}@{meta['client_id']}"
+
+    once = StreamingFolder(shapes)
+    once.add({**meta, "client_id": key}, jax.tree.map(np.copy, wire))
+
+    twice = StreamingFolder(shapes)
+    twice.add({**meta, "client_id": key}, jax.tree.map(np.copy, wire))
+    assert twice.has(key)
+    assert twice.discard(key) is True        # the re-home dedup path
+    assert not twice.has(key)
+    assert twice.discard(key) is False       # nothing left to drop
+    twice.add({**meta, "client_id": key}, jax.tree.map(np.copy, wire))
+
+    once.finalize()
+    twice.finalize()
+    assert twice.count == once.count == 1
+    assert twice.total_w == once.total_w
+    assert _tree_bytes(twice.wsum) == _tree_bytes(once.wsum)
+    # Post-finalize discard must refuse: the sum already includes it.
+    with pytest.raises(RuntimeError):
+        twice.discard(key)
+
+
+# ----------------------------------------------- record-key registry -----
+TREE_KEYS = ("agg_id", "agg_buffer_k", "agg_buffer_staged",
+             "agg_buffer_rate_per_s", "oldest_version", "folded_keys",
+             "rehomed_devices", "rehomed_total", "agg_fold_tracking_min",
+             "aggregators")
+
+
+def test_tree_gated_record_keys_registered():
+    assert set(TREE_KEYS) <= set(metric_catalog.RECORD_KEYS)
+
+
+# --------------------------------------------- per-buffer mask cohorts ---
+def test_buffer_mask_cohorts_partition_and_predicted_dropouts():
+    assignment = {str(i): i % 3 for i in range(9)}
+    cohorts = hierarchical.buffer_mask_cohorts(assignment)
+    assert sorted(cohorts) == [0, 1, 2]
+    # A mask pair never spans two buffers: the cohorts partition the
+    # assignment exactly, each sorted for deterministic pair order.
+    assert sorted(d for devs in cohorts.values() for d in devs) \
+        == sorted(assignment)
+    for devs in cohorts.values():
+        assert devs == sorted(devs, key=str)
+    # Pruned devices are predicted dropouts — excluded BEFORE mask
+    # commitment, so they never appear in any pairing cohort.
+    pruned = hierarchical.buffer_mask_cohorts(assignment, pruned=["4", "7"])
+    assert "4" not in pruned[1] and "7" not in pruned[1]
+    assert sum(len(d) for d in pruned.values()) == 7
+
+
+def test_async_mask_cost_predicted_dropout_is_free():
+    assignment = {str(i): (0 if i < 6 else 1) for i in range(10)}
+    bill = hierarchical.async_mask_cost(assignment, param_count=1000,
+                                        pruned=["2", "3"])
+    assert bill["predicted_dropouts"] == 2
+    # The headline: a pruned client never masked, so its departure costs
+    # zero share recoveries — unlike a reactive mid-buffer death, which
+    # costs its full degree.
+    assert bill["predicted_recovery_shares"] == 0
+    assert bill["active_devices"] == 8
+    b0 = bill["buffers"][0]
+    assert b0["devices"] == 4                 # 6 assigned, 2 pruned
+    assert b0["pairs_per_device"] == 3        # masks span the buffer only
+    assert b0["reactive_recovery_shares"] == b0["pairs_per_device"]
+    assert bill["buffers"][1]["pairs_per_device"] == 3
+    assert bill["pairs_total"] == (4 * 3 + 4 * 3) // 2
+
+
+# ------------------------------------------------- two-tier fleetsim -----
+@pytest.mark.slow
+def test_fleetsim_tree_async_two_tier_smoke():
+    fs = make_fleet(num_devices=32, cohort=8, chunk=8)
+    hist = fs.fit_async(8, buffer_size="auto", aggregators=2,
+                        max_staleness=20, auto_interval_min=2.0)
+    assert len(hist) == 8
+    assert [r["model_version"] for r in hist] == list(range(1, 9))
+    for rec in hist:
+        assert rec["aggregators"] == 2
+        assert rec["agg_id"] in (0, 1)
+        assert 1 <= rec["agg_buffer_k"] <= 8
+        assert 0.0 <= rec["agg_fold_tracking_min"] <= 1.0
+        assert np.isfinite(rec["train_loss"])
+        assert set(rec) <= set(metric_catalog.RECORD_KEYS)
+    # Both slices actually fold: per-slice buffers, not one hot slice.
+    assert {r["agg_id"] for r in hist} == {0, 1}
+
+
+def test_fleetsim_default_async_records_carry_no_tree_keys():
+    """aggregators=0 (the default) must keep the flat async record
+    schema byte-identical — none of the tree-gated keys may leak."""
+    fs = make_fleet(num_devices=32, cohort=8, chunk=8)
+    hist = fs.fit_async(4, buffer_size=8, max_staleness=8)
+    for rec in hist:
+        assert not (set(rec) & set(TREE_KEYS))
+    with pytest.raises(ValueError, match="aggregator"):
+        fs.fit_async(2, buffer_size=8, aggregators=1)

@@ -10,7 +10,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from colearn_federated_learning_tpu.fed.engine import FederatedLearner
@@ -24,6 +23,7 @@ from colearn_federated_learning_tpu.utils.config import (
     ModelConfig,
     RunConfig,
 )
+from colearn_federated_learning_tpu.utils.jax_compat import shard_map
 
 
 def _run_sharded(fn, mesh, args, specs, out_spec):
