@@ -5,12 +5,17 @@ with an ``id``, a ``hint`` and a ``check(ctx)`` generator; registering it
 (via :func:`register`) is all a later PR needs to add a checker (~30
 lines including the rule body).  Everything cross-cutting lives here:
 
-- per-line ``# colearn: noqa(RULE[,RULE])`` suppressions (bare
+- per-line ``# colearn: noqa(RULE[,RULE]): reason`` suppressions (bare
   ``# colearn: noqa`` suppresses every rule on that line);
 - a checked-in JSON baseline (fingerprints of accepted findings — see
   findings.Finding.fingerprint) subtracted from the report;
 - dead-suppression detection (CL000): a noqa comment that suppressed
   nothing is itself a finding, so suppressions cannot rot in place;
+- unreasoned-suppression detection (CL022): a live rule-listed noqa
+  without a ``: reason`` suffix is itself a finding — every suppression
+  must say why (concurrency suppressions should cite a witness-clean
+  soak).  Blanket ``# colearn: noqa`` is exempt but CL000 still retires
+  it when dead;
 - ``[tool.colearn.lint]`` config from pyproject.toml (rule
   enable/disable lists, path excludes, baseline path).
 
@@ -35,10 +40,12 @@ from colearn_federated_learning_tpu.analysis.findings import Finding
 _NOQA_RE = re.compile(
     r"#\s*colearn:\s*noqa(?:\s*\(\s*(?P<rules>[A-Z]{2}\d{3}"
     r"(?:\s*,\s*[A-Z]{2}\d{3})*)\s*\))?"
+    r"(?P<reason>\s*:\s*\S.*)?"
 )
 _HOT_RE = re.compile(r"#\s*colearn:\s*hot\b")
 
 DEAD_SUPPRESSION_RULE = "CL000"
+UNREASONED_SUPPRESSION_RULE = "CL022"
 PARSE_ERROR_RULE = "CL999"
 
 
@@ -257,6 +264,9 @@ class LintEngine:
             check_dead_suppressions
             and DEAD_SUPPRESSION_RULE not in self.config.disable
         )
+        self.check_unreasoned_suppressions = (
+            UNREASONED_SUPPRESSION_RULE not in self.config.disable
+        )
 
     # ------------------------------------------------------------------
     def _relpath(self, path: str) -> str:
@@ -266,15 +276,19 @@ class LintEngine:
         return path
 
     def _suppressions(self, ctx: FileContext) -> dict:
-        """``{lineno: set(rule_ids) | None}`` — None = blanket noqa."""
+        """``{lineno: (set(rule_ids) | None, has_reason)}`` — a None rule
+        set is a blanket noqa."""
         out: dict = {}
         for lineno, text in ctx.comments.items():
             m = _NOQA_RE.search(text)
             if not m:
                 continue
             rules = m.group("rules")
-            out[lineno] = (None if rules is None else
-                           {r.strip() for r in rules.split(",")})
+            out[lineno] = (
+                None if rules is None else
+                {r.strip() for r in rules.split(",")},
+                m.group("reason") is not None,
+            )
         return out
 
     def lint_file(self, path: str) -> tuple:
@@ -298,7 +312,8 @@ class LintEngine:
         kept: list = []
         suppressed = 0
         for f in raw:
-            rules_at = supp.get(f.line, "absent")
+            entry = supp.get(f.line)
+            rules_at = "absent" if entry is None else entry[0]
             if rules_at is None or (rules_at != "absent"
                                     and f.rule in rules_at):
                 suppressed += 1
@@ -314,6 +329,20 @@ class LintEngine:
                             "silences nothing",
                     hint="remove the comment (or fix the rule list in "
                          "parentheses)",
+                    line_text=ctx.line_text(lineno),
+                ))
+        if self.check_unreasoned_suppressions:
+            for lineno in sorted(used_lines):
+                rules_at, has_reason = supp[lineno]
+                if rules_at is None or has_reason:
+                    continue
+                kept.append(Finding(
+                    rule=UNREASONED_SUPPRESSION_RULE, path=ctx.relpath,
+                    line=lineno, col=0,
+                    message="suppression without a reason: append "
+                            "`: <why this is safe>` to the noqa",
+                    hint="e.g. `# colearn: noqa(CL019): witness-clean "
+                         "in chaos --tree-async --lock-witness`",
                     line_text=ctx.line_text(lineno),
                 ))
         return kept, suppressed

@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL016).
+"""The colearn rule set (CL001–CL021).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -13,6 +13,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional
 
+from colearn_federated_learning_tpu.analysis import lock_regions
 from colearn_federated_learning_tpu.analysis import metric_catalog
 from colearn_federated_learning_tpu.analysis.engine import (
     FileContext,
@@ -994,3 +995,236 @@ class RecordKeyDrift(Rule):
                             if isinstance(k, ast.Constant):
                                 yield from self._check_key(
                                     ctx, node, k.value)
+
+
+# ------------------------------------------------------- CL017–CL021 ------
+# Concurrency family.  All five share the per-class lock index built by
+# analysis.lock_regions and are scoped to the threaded planes: comm/,
+# telemetry/, faults/.
+
+_CONCURRENCY_DIRS = ("comm", "telemetry", "faults")
+
+
+def _concurrency_scope(ctx: FileContext) -> bool:
+    return any(ctx.in_dir(d) for d in _CONCURRENCY_DIRS)
+
+
+# ----------------------------------------------------------------- CL017 --
+@register
+class GuardedByInference(Rule):
+    """An attribute consistently touched under one lock but read/written
+    bare on a thread-reachable path is a data race waiting for a chaos
+    soak to find it — flag it now, statically."""
+
+    id = "CL017"
+    title = "unguarded access to a lock-guarded attribute"
+    hint = ("acquire the guarding lock around the access, or pin the "
+            "contract with `# colearn: guarded-by(_lock)` / a reasoned "
+            "noqa citing a witness-clean soak")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _concurrency_scope(ctx):
+            return
+        for idx in lock_regions.class_indexes(ctx):
+            if not idx.locks:
+                continue
+            guards = idx.inferred_guards()
+            reachable = idx.reachable_methods()
+            for acc in idx.accesses:
+                attr_guards = guards.get(acc.attr)
+                if not attr_guards or acc.method == "__init__":
+                    continue
+                if acc.held & attr_guards:
+                    continue
+                if acc.method not in reachable:
+                    continue
+                locks = "/".join(sorted(attr_guards))
+                yield self.finding(
+                    ctx, acc.node,
+                    f"{idx.name}.{acc.attr} is guarded by {locks} "
+                    f"elsewhere but {acc.kind} without it in "
+                    f"thread-reachable `{acc.method}`")
+
+
+# ----------------------------------------------------------------- CL018 --
+@register
+class LockOrderCycle(Rule):
+    """Two threads acquiring the same locks in opposite orders deadlock;
+    the acquire-while-holding graph must stay a DAG."""
+
+    id = "CL018"
+    title = "lock-order cycle (deadlock potential)"
+    hint = ("break the cycle: always acquire these locks in one global "
+            "order, or narrow one critical section so the nesting "
+            "disappears")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _concurrency_scope(ctx):
+            return
+        for idx in lock_regions.class_indexes(ctx):
+            for cycle in idx.cycles():
+                ring = " -> ".join(cycle + [cycle[0]])
+                first_edge = (cycle[0], cycle[1 % len(cycle)])
+                site = idx.edge_sites.get(
+                    first_edge) or idx.classdef
+                yield self.finding(
+                    ctx, site,
+                    f"{idx.name} acquires locks in a cycle: {ring}")
+
+
+# ----------------------------------------------------------------- CL019 --
+@register
+class BlockingWhileHolding(Rule):
+    """Sleeping, socket I/O, or broker RPC inside a critical section
+    stalls every thread contending for the lock (and turned a lock into
+    a convoy in the async plane more than once)."""
+
+    id = "CL019"
+    title = "blocking call while holding a lock"
+    hint = ("move the blocking call outside the `with self._lock:` "
+            "block — snapshot state under the lock, do I/O bare, merge "
+            "results back under the lock")
+
+    _BLOCKING_TAILS = {
+        "sleep", "recv", "recv_into", "recvfrom", "send", "sendall",
+        "sendto", "accept", "connect", "create_connection", "request",
+        "publish", "subscribe", "select", "acquire", "wait",
+        "fetch_aggregators",
+    }
+    _BLOCKING_CTORS = {"BrokerClient", "TensorClient", "TensorServer"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _concurrency_scope(ctx):
+            return
+        for idx in lock_regions.class_indexes(ctx):
+            if not idx.locks:
+                continue
+            for node in ast.walk(idx.classdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = idx.held_at(node)
+                if not held:
+                    continue
+                func = node.func
+                tail = (func.attr if isinstance(func, ast.Attribute)
+                        else func.id if isinstance(func, ast.Name)
+                        else "")
+                if tail == "wait":
+                    # waiting on the very condition you hold is the CV
+                    # protocol (CL020 checks the predicate loop).
+                    recv = lock_regions.self_attr(
+                        func.value) if isinstance(
+                            func, ast.Attribute) else None
+                    if recv is not None and recv in held:
+                        continue
+                if tail in self._BLOCKING_TAILS or (
+                        isinstance(func, ast.Name)
+                        and func.id in self._BLOCKING_CTORS):
+                    locks = "/".join(sorted(held))
+                    what = tail or getattr(func, "id", "call")
+                    yield self.finding(
+                        ctx, node,
+                        f"{idx.name} calls blocking `{what}` while "
+                        f"holding {locks}")
+
+
+# ----------------------------------------------------------------- CL020 --
+@register
+class CvWaitWithoutPredicateLoop(Rule):
+    """`Condition.wait` wakes spuriously and after stolen wakeups; a
+    wait that is not re-checked in a `while` loop acts on stale state."""
+
+    id = "CL020"
+    title = "Condition.wait outside a predicate loop"
+    hint = ("wrap the wait: `while not predicate: cv.wait(timeout)` "
+            "(or use cv.wait_for(predicate, timeout))")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _concurrency_scope(ctx):
+            return
+        for idx in lock_regions.class_indexes(ctx):
+            if not idx.conditions:
+                continue
+            for name, fn in idx.methods.items():
+                yield from self._scan(ctx, idx, fn, in_while=False)
+
+    def _scan(self, ctx, idx, node, in_while) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # nested body runs elsewhere: loop context does not carry
+                yield from self._scan(ctx, idx, child, in_while=False)
+                continue
+            inner = in_while or isinstance(child, ast.While)
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "wait"):
+                recv = lock_regions.self_attr(child.func.value)
+                if recv in idx.conditions and not in_while:
+                    yield self.finding(
+                        ctx, child,
+                        f"{idx.name}.{recv}.wait() outside a `while` "
+                        f"predicate loop")
+            yield from self._scan(ctx, idx, child, inner)
+
+
+# ----------------------------------------------------------------- CL021 --
+@register
+class UnlockedIteration(Rule):
+    """Iterating a shared dict/list/set while another thread mutates it
+    raises `RuntimeError: changed size during iteration` — or worse,
+    silently skips entries."""
+
+    id = "CL021"
+    title = "iteration over a guarded collection without its lock"
+    hint = ("hold the guard while iterating, or snapshot first "
+            "(`list(self._x.items())` under the lock, iterate the copy)")
+
+    _VIEW_TAILS = {"items", "keys", "values"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _concurrency_scope(ctx):
+            return
+        for idx in lock_regions.class_indexes(ctx):
+            if not idx.locks or not idx.collections:
+                continue
+            guards = idx.inferred_guards()
+            shared = {a: g for a, g in guards.items()
+                      if a in idx.collections}
+            if not shared:
+                continue
+            for node in ast.walk(idx.classdef):
+                iters = self._iter_exprs(node)
+                for expr in iters:
+                    attr = self._iterated_attr(expr)
+                    if attr is None or attr not in shared:
+                        continue
+                    if idx.held_at(node) & shared[attr]:
+                        continue
+                    locks = "/".join(sorted(shared[attr]))
+                    yield self.finding(
+                        ctx, expr,
+                        f"{idx.name}.{attr} iterated without {locks}")
+
+    @staticmethod
+    def _iter_exprs(node: ast.AST) -> list:
+        if isinstance(node, ast.For):
+            return [node.iter]
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return [gen.iter for gen in node.generators]
+        return []
+
+    def _iterated_attr(self, expr: ast.AST) -> Optional[str]:
+        """``self._x`` or ``self._x.items()/keys()/values()`` — a
+        `list(...)`/`sorted(...)` wrapper counts as a snapshot and is
+        not reported (it still races in theory, but is the conventional
+        copy idiom and completes in one pass)."""
+        attr = lock_regions.self_attr(expr)
+        if attr is not None:
+            return attr
+        if (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in self._VIEW_TAILS):
+            return lock_regions.self_attr(expr.func.value)
+        return None

@@ -684,6 +684,29 @@ def cmd_coordinate(args: argparse.Namespace) -> int:
     return 0
 
 
+def _lock_witness_ok(summary: dict, args: argparse.Namespace) -> bool:
+    """Lock-witness gate for the async/tree-async soaks: with
+    ``--lock-witness`` the fleet must have produced per-process reports
+    showing real lock traffic and ZERO witnessed ordering inversions or
+    unguarded guarded-structure accesses."""
+    if not args.lock_witness:
+        return True
+    lw = summary.get("lock_witness") or {}
+    ok = (bool(lw.get("enabled"))
+          and int(lw.get("reports", 0)) >= 1
+          and int(lw.get("acquires", 0)) >= 1
+          and int(lw.get("inversions", 0)) == 0
+          and int(lw.get("unguarded", 0)) == 0)
+    if not ok:
+        print(f"# lock-witness gate failed: "
+              f"{json.dumps({k: lw.get(k) for k in ('enabled', 'reports', 'acquires', 'inversions', 'unguarded')})}",
+              file=sys.stderr)
+        for rec in (lw.get("inversion_records", [])
+                    + lw.get("unguarded_records", [])):
+            print(f"#   {json.dumps(rec)}", file=sys.stderr)
+    return ok
+
+
 def cmd_chaos(args: argparse.Namespace) -> int:
     """Chaos soak.  Default: broker + workers + coordinator in THIS
     process, a fault plan installed after the warmup round (faults/soak).
@@ -710,6 +733,11 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print("--tree-async is its own multi-process gate; "
               "drop --secure/--mp/--agg/--async", file=sys.stderr)
         return 2
+    if args.lock_witness and not (args.chaos_async
+                                  or args.chaos_tree_async):
+        print("--lock-witness instruments the buffered-async fleets; "
+              "pair it with --async or --tree-async", file=sys.stderr)
+        return 2
     if args.chaos_tree_async:
         from colearn_federated_learning_tpu.faults import procsoak
 
@@ -718,9 +746,11 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             workdir=args.workdir, round_timeout=args.mp_round_timeout,
             timeout_s=args.mp_timeout, kill=not args.no_faults,
             log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+            lock_witness=args.lock_witness,
         )
         print(json.dumps(summary))
-        ok = (summary["exit_code"] == 0
+        ok = (_lock_witness_ok(summary, args)
+              and summary["exit_code"] == 0
               and summary["oracle_exit_code"] == 0
               and summary["aggregations_run"] >= args.rounds
               and summary["oracle_aggregations_run"] >= args.rounds
@@ -750,9 +780,11 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             workdir=args.workdir, round_timeout=args.mp_round_timeout,
             timeout_s=args.mp_timeout, kill=not args.no_faults,
             log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+            lock_witness=args.lock_witness,
         )
         print(json.dumps(summary))
-        ok = (summary["exit_code"] == 0
+        ok = (_lock_witness_ok(summary, args)
+              and summary["exit_code"] == 0
               and summary["baseline_exit_code"] == 0
               and summary["aggregations_run"] >= args.rounds
               and summary["baseline_aggregations_run"] >= args.rounds
@@ -1057,9 +1089,23 @@ def cmd_lint(args: argparse.Namespace) -> int:
         print(f"colearn lint: baselined {len(result.findings)} finding(s) "
               f"({len(entries)} fingerprint(s)) -> {target}")
         return 0
+    if args.gate:
+        # CI posture: the baseline is a MIGRATION vehicle, not a place
+        # findings live.  The gate fails when any fingerprint is still
+        # parked there, so every suppression is an inline, reasoned noqa.
+        gate_baseline = baseline_path or os.path.join(root, config.baseline)
+        entries = lint_engine.load_baseline(gate_baseline)
+        if entries:
+            print(f"colearn lint --gate: baseline {gate_baseline} still "
+                  f"carries {len(entries)} fingerprint(s); fix the "
+                  f"findings or move each to an inline "
+                  f"`# colearn: noqa(CLxxx): <reason>`", file=sys.stderr)
+            return 1
     result = eng.run(paths, baseline_path=baseline_path)
     if args.format == "json":
         print(reporters.render_json(result))
+    elif args.format == "sarif":
+        print(reporters.render_sarif(result))
     else:
         print(reporters.render_text(result))
     return result.exit_code
@@ -1444,6 +1490,13 @@ def main(argv: list[str] | None = None) -> int:
                               "tail-loss parity vs a same-seed kill-free "
                               "tree oracle "
                               "(faults/procsoak.run_tree_async_soak)")
+    p_chaos.add_argument("--lock-witness", action="store_true",
+                         help="(--async/--tree-async) run every fleet "
+                              "process with the runtime lock witness "
+                              "(faults/lockwitness) armed and gate on "
+                              "zero observed ordering inversions and "
+                              "zero unguarded guarded-structure "
+                              "accesses")
     p_chaos.add_argument("--workdir", default=None,
                          help="--mp scratch dir for checkpoints + process "
                               "logs (default: a fresh temp dir)")
@@ -1547,7 +1600,13 @@ def main(argv: list[str] | None = None) -> int:
     p_lint.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the installed "
                              "package)")
-    p_lint.add_argument("--format", choices=["text", "json"], default="text")
+    p_lint.add_argument("--format", choices=["text", "json", "sarif"],
+                        default="text")
+    p_lint.add_argument("--gate", action="store_true",
+                        help="CI gate: additionally fail when the "
+                             "baseline file still carries accepted "
+                             "fingerprints — every suppression must be "
+                             "an inline reasoned noqa")
     p_lint.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all registered)")

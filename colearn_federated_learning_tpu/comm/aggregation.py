@@ -164,12 +164,12 @@ class StreamingFolder(UpdateFolder):
         else:
             # int8 dequantize is inherently dense (every entry carries
             # signal); "none" already arrives dense.
-            delta = compression.decompress_delta(  # colearn: noqa(CL013)
+            delta = compression.decompress_delta(  # colearn: noqa(CL013): int8/none payloads are inherently dense
                 delta, meta, shapes=self.shapes)
             # Wire deltas are host numpy straight off the decode — the
             # asarray normalizes dtypes/views, it cannot touch a device.
             contrib = pytrees.tree_scale(
-                jax.tree.map(np.asarray, delta), w)  # colearn: noqa(CL012)
+                jax.tree.map(np.asarray, delta), w)  # colearn: noqa(CL012): wire deltas are host numpy, no device touch
             if self._placement is not None:
                 # Shard-wise staging: each leaf becomes the tuple of its
                 # per-shard slices (uplink decode scattered symmetrically).

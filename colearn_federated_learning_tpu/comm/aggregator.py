@@ -63,6 +63,7 @@ from colearn_federated_learning_tpu.comm.transport import (
     TensorServer,
 )
 from colearn_federated_learning_tpu import telemetry
+from colearn_federated_learning_tpu.faults import lockwitness
 from colearn_federated_learning_tpu.utils.config import ExperimentConfig
 
 # Retained announce/heartbeat topic per aggregator (control plane).
@@ -171,7 +172,7 @@ class AggregatorServer:
         )
 
         self.arrival = ArrivalEstimator()
-        self._abuf_cv = threading.Condition()
+        self._abuf_cv = lockwitness.condition(f"agg{agg_id}.abuf_cv")
         self._abuf_folder = None            # StreamingFolder | None
         self._abuf_shapes = None
         self._abuf_entries: dict[str, dict] = {}   # dedup key -> bookkeeping
@@ -326,7 +327,7 @@ class AggregatorServer:
         return ({"meta": {"agg_id": self.agg_id, "staged": staged,
                           "dedup": dup}}, None)
 
-    def _auto_k(self, interval_s: float, slice_devices: int) -> int:
+    def _auto_k(self, interval_s: float, slice_devices: int) -> int:  # colearn: holds(_abuf_cv)
         """Auto-K for this slice: the K that folds once per
         ``interval_s`` at the slice's observed arrival rate, clamped to
         the slice size and slew-limited to [K/2, 3K/2] per drain (the
@@ -538,7 +539,7 @@ class AggregatorServer:
                             for fut in cf.as_completed(futs,
                                                        timeout=budget):
                                 take(fut, pending.pop(fut))
-                        except cf.TimeoutError:     # colearn: noqa(CL003)
+                        except cf.TimeoutError:     # colearn: noqa(CL003): stragglers charged to health ledger below
                             pass    # stragglers: charged below
                         for fut, did in pending.items():
                             if fut.done():

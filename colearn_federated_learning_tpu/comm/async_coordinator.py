@@ -87,6 +87,7 @@ from colearn_federated_learning_tpu.comm.enrollment import (
 )
 from colearn_federated_learning_tpu.comm import protocol
 from colearn_federated_learning_tpu.comm.transport import TensorClient
+from colearn_federated_learning_tpu.faults import lockwitness
 from colearn_federated_learning_tpu.fed import setup as setup_lib
 from colearn_federated_learning_tpu.fed import strategies
 from colearn_federated_learning_tpu import telemetry
@@ -252,8 +253,8 @@ class AsyncFederatedCoordinator:
         # (version, params_np, encoded body) — every pump dispatching model
         # version v shares ONE encoded frame (serialize-once per version).
         self._snap_cache: Optional[tuple] = None
-        self._state_lock = threading.Lock()
-        self._version_cv = threading.Condition()
+        self._state_lock = lockwitness.lock("coord.state_lock")
+        self._version_cv = lockwitness.condition("coord.version_cv")
         self._cv_poll_s = 0.1
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -266,7 +267,7 @@ class AsyncFederatedCoordinator:
         # discards as deadline misses).  Gated on run.health_dir; the
         # pump threads share one ledger, hence the lock.
         self.health = None
-        self._health_lock = threading.Lock()
+        self._health_lock = lockwitness.lock("coord.health_lock")
         self._health_retry_seen: dict[str, float] = {}
         if config.run.health_dir:
             self.health = telemetry.HealthLedger(config.run.health_dir,
@@ -310,8 +311,12 @@ class AsyncFederatedCoordinator:
         self.tree_mode = self.num_aggregators > 0
         self.agg_interval_s = float(config.run.agg_buffer_interval_s)
         self._broker_addr = (broker_host, broker_port)
-        self._aggs: dict[int, dict] = {}        # agg_id -> announce record
-        self._agg_lock = threading.Lock()
+        self._agg_lock = lockwitness.lock("coord.agg_lock")
+        self._aggs: dict[int, dict] = lockwitness.guarded(
+            {}, "coord._aggs", self._agg_lock)  # colearn: guarded-by(_agg_lock)
+        # I/O-serialization gate for _refresh_aggs: try-acquired (never
+        # blocked on, never nested) so broker RPC happens under no lock.
+        self._agg_refreshing = lockwitness.lock("coord.agg_refreshing")
         self._agg_sub: Optional[BrokerClient] = None
         # Sticky-dead addresses: once an aggregator PROCESS (host, port)
         # is declared dead, nothing is ever drained from that address
@@ -321,8 +326,11 @@ class AsyncFederatedCoordinator:
         self._dead_addrs: set = set()
         self._dead_aggs: set = set()
         self._assign: dict[str, int] = {}       # device -> agg_id
-        self._inflight: dict[str, tuple] = {}   # dedup key -> contribution
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = lockwitness.lock("coord.inflight_lock")
+        # dedup key -> contribution
+        self._inflight: dict[str, tuple] = lockwitness.guarded(
+            {}, "coord._inflight",
+            self._inflight_lock)  # colearn: guarded-by(_inflight_lock)
         self._partials: queue.Queue = queue.Queue()
         self._drainers: list[threading.Thread] = []
         self._failovers_pending = 0
@@ -614,7 +622,9 @@ class AsyncFederatedCoordinator:
         # re-enrolled device gets a fresh pump under the same name.
         self._threads = [t for t in self._threads if t.is_alive()]
         started = {t.name for t in self._threads}
-        for d in self.trainers:
+        with self._state_lock:
+            roster = list(self.trainers)
+        for d in roster:
             name = f"dispatch-{d.device_id}"
             if name in started:
                 continue
@@ -712,11 +722,23 @@ class AsyncFederatedCoordinator:
         """Drain the retained announce topic into ``_aggs`` (latest
         record per agg_id wins — a restarted aggregator overwrites its
         dead predecessor's address).  Heals the subscription in place
-        when the broker itself was restarted."""
+        when the broker itself was restarted.
+
+        All broker I/O happens OUTSIDE ``_agg_lock`` (CL019): the
+        subscription is serialized by a non-blocking try-acquire on the
+        dedicated ``_agg_refreshing`` gate — a contending caller returns
+        immediately and rides on the in-flight refresh (every caller is
+        a retry loop, so a ~drain_timeout-stale heartbeat view heals on
+        its next pass) — and announce records drain into a local dict
+        that is merged under ``_agg_lock`` at the end."""
         from colearn_federated_learning_tpu.comm import aggregator as agg_lib
 
-        with self._agg_lock:
-            if self._agg_sub is None:
+        if not self._agg_refreshing.acquire(blocking=False):
+            return
+        try:
+            with self._agg_lock:
+                sub = self._agg_sub
+            if sub is None:
                 try:
                     sub = BrokerClient(self._broker_addr[0],
                                        self._broker_addr[1],
@@ -727,21 +749,32 @@ class AsyncFederatedCoordinator:
                         "comm.broker_reconnects_total",
                         labels={"outcome": "failed"}).inc()
                     return
-                self._agg_sub = sub
+                with self._agg_lock:
+                    self._agg_sub = sub
+            fresh: dict = {}
             try:
-                agg_lib.fetch_aggregators(self._agg_sub, self._aggs,
+                agg_lib.fetch_aggregators(sub, fresh,
                                           drain_timeout=drain_timeout)
             except (protocol.ConnectionClosed, OSError):
+                with self._agg_lock:
+                    if self._agg_sub is sub:
+                        self._agg_sub = None  # broker died; rebuilt next call
                 try:
-                    self._agg_sub.close()
-                finally:
-                    self._agg_sub = None   # broker died; rebuilt next call
+                    sub.close()
+                except OSError:
+                    protocol.count_suppressed()  # already torn down
+                return
+            if fresh:
+                with self._agg_lock:
+                    self._aggs.update(fresh)
+        finally:
+            self._agg_refreshing.release()
 
     def _live_agg_ids(self) -> list[int]:
         with self._agg_lock:
             return sorted(a for a in self._aggs if a not in self._dead_aggs)
 
-    def _recompute_assignment(self) -> None:
+    def _recompute_assignment(self) -> None:  # colearn: holds(_agg_lock)
         """Device → aggregator map over the LIVE aggregators, health-
         driven when a ledger is attached (chronic stragglers concentrate
         in the last — deepest-buffer — slices).  Caller holds
@@ -752,7 +785,9 @@ class AsyncFederatedCoordinator:
         if not live:
             self._assign = {}
             return
-        ids = sorted((t.device_id for t in self.trainers), key=str)
+        with self._state_lock:
+            roster = list(self.trainers)
+        ids = sorted((t.device_id for t in roster), key=str)
         scores = None
         if self.health is not None:
             with self._health_lock:

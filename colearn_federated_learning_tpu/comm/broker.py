@@ -23,6 +23,7 @@ import threading
 from typing import Optional
 
 from colearn_federated_learning_tpu.comm import protocol
+from colearn_federated_learning_tpu.faults import lockwitness
 
 
 def _match(pattern: str, topic: str) -> bool:
@@ -41,8 +42,9 @@ class MessageBroker:
         self._srv.bind((host, port))
         self._srv.listen(64)
         self.host, self.port = self._srv.getsockname()
-        self._lock = threading.Lock()
-        self._subs: dict[socket.socket, list[str]] = {}
+        self._lock = lockwitness.lock("broker.lock")
+        self._subs: dict[socket.socket, list[str]] = lockwitness.guarded(
+            {}, "broker._subs", self._lock)  # colearn: guarded-by(_lock)
         # Per-socket write locks: publisher threads fan out concurrently and
         # protocol frames must never interleave on a subscriber's stream.
         self._wlocks: dict[socket.socket, threading.Lock] = {}
@@ -88,7 +90,7 @@ class MessageBroker:
             try:
                 # Blocking by design: stop() always sends a wake_accept
                 # connection, so this never outlives the broker.
-                conn, _ = self._srv.accept()  # colearn: noqa(CL002)
+                conn, _ = self._srv.accept()  # colearn: noqa(CL002): stop() wakes the accept via a sentinel connect
             except OSError:
                 return  # listener closed by stop()
             # Re-check AFTER accept: some loopback shims deliver one more
@@ -116,7 +118,7 @@ class MessageBroker:
                     self._publish(header, body)
                 elif op == "ping":
                     self._send(conn, {"op": "pong"}, b"")
-        except protocol.ConnectionClosed:  # colearn: noqa(CL003)
+        except protocol.ConnectionClosed:  # colearn: noqa(CL003): client hangup is normal teardown
             pass                           # normal client disconnect
         except (OSError, ValueError):
             protocol.count_suppressed()  # flaky/buggy peer; drop it

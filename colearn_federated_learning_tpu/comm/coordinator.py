@@ -668,7 +668,7 @@ class FederatedCoordinator:
         try:
             for fut in cf.as_completed(futs, timeout=budget):
                 take(fut, futs[fut])
-        except cf.TimeoutError:   # colearn: noqa(CL003)
+        except cf.TimeoutError:   # colearn: noqa(CL003): stragglers dropped/counted/reconnected below
             pass  # stragglers handled below: dropped, counted, reconnected
         for fut, dev in futs.items():
             if fut in handled:
@@ -1229,7 +1229,7 @@ class FederatedCoordinator:
                 try:
                     for fut in cf.as_completed(futs, timeout=timeout):
                         take(fut, pending.pop(fut))
-                except cf.TimeoutError:     # colearn: noqa(CL003)
+                except cf.TimeoutError:     # colearn: noqa(CL003): stragglers cancelled and counted below
                     pass
                 for fut, i in pending.items():
                     if fut.done():

@@ -102,7 +102,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     while got < n:
         # The per-read deadline is the caller's settimeout (BrokerClient
         # drains via a reader thread; TensorServer sets a serve timeout).
-        r = sock.recv_into(view[got:], n - got)  # colearn: noqa(CL002)
+        r = sock.recv_into(view[got:], n - got)  # colearn: noqa(CL002): deadline is the caller's settimeout
         if not r:
             raise ConnectionClosed(f"peer closed after {got}/{n} bytes")
         got += r

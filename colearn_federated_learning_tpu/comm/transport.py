@@ -153,7 +153,7 @@ class TensorServer:
             try:
                 # Blocking by design: stop() always sends a wake_accept
                 # connection, so this never outlives the server.
-                conn, _ = self._srv.accept()  # colearn: noqa(CL002)
+                conn, _ = self._srv.accept()  # colearn: noqa(CL002): stop() wakes the accept via a sentinel connect
             except OSError:
                 return  # listener closed by stop()
             # Re-check AFTER accept: some loopback shims deliver one more
@@ -175,7 +175,7 @@ class TensorServer:
                 try:
                     if ip is not None:
                         ip.server_request(self, conn, header)
-                except SkipRequest:       # colearn: noqa(CL003)
+                except SkipRequest:       # colearn: noqa(CL003): interposer-ordered drop, counted at the seam
                     continue              # request "lost" BY DESIGN: the
                     # interposer asked for a drop; no reply at all
                 tree, meta = bytes_to_pytree(body) if body else (None, {})
@@ -193,7 +193,7 @@ class TensorServer:
                 if ip is not None:
                     ip.server_reply(self, conn, header)
                 protocol.send_msg(conn, out_header, out_body)
-        except protocol.ConnectionClosed:  # colearn: noqa(CL003)
+        except protocol.ConnectionClosed:  # colearn: noqa(CL003): peer hangup is normal teardown
             pass                           # normal peer disconnect
         except (OSError, ValueError):
             protocol.count_suppressed()  # flaky/buggy peer; drop it

@@ -451,7 +451,7 @@ class DeviceWorker:
         return (jnp.asarray(np.stack(keys)),
                 jnp.asarray(np.asarray(signs, np.float32)))
 
-    def _peer_record(self, p: int, round_idx: int) -> tuple:
+    def _peer_record(self, p: int, round_idx: int) -> tuple:  # colearn: holds(_dh_lock)
         """(pubkey_str, pair PRNG key uint32[2], raw DH secret bytes) for
         peer ``p``.  Caller holds ``_dh_lock``.  The secret bytes feed the
         share-transport keystream (privacy/dropout.py) so recovery shares
@@ -462,7 +462,9 @@ class DeviceWorker:
             raise RuntimeError("worker is stopped")
         if self._dh_lookup is None:
             bh, bp = self._broker_addr
-            self._dh_lookup = BrokerClient(
+            # _dh_lock exists to serialize this dedicated connection (see
+            # _pair_keys docstring); nothing latency-sensitive contends.
+            self._dh_lookup = BrokerClient(  # colearn: noqa(CL019): _dh_lock serializes this dedicated connection by design; ctor bounded by CONNECT_TIMEOUT
                 bh, bp, timeout=protocol.CONNECT_TIMEOUT)
         if self._peer_round != round_idx:
             self._peer_info_cache.clear()

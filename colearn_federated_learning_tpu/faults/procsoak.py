@@ -703,6 +703,7 @@ def _run_async_fleet(
     n_aggregators: int = 0,
     fault_plan: Optional[dict] = None,
     log_fn: Optional[Callable[[dict], None]] = None,
+    lock_witness: bool = False,
 ) -> dict:
     """One buffered-async subprocess federation (broker + N workers +
     async coordinator), with the proc-soak kill loop re-keyed on
@@ -721,6 +722,19 @@ def _run_async_fleet(
     env = dict(os.environ)
     env["PYTHONUNBUFFERED"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
+    witness_dir = os.path.join(workdir, "lockwitness")
+    if lock_witness:
+        # Every fleet process runs its locks through
+        # faults.lockwitness and dumps a per-pid report at exit; the
+        # summary below aggregates them into a zero-inversion /
+        # zero-unguarded gate.
+        env["COLEARN_LOCK_WITNESS"] = "1"
+        env["COLEARN_LOCK_WITNESS_DIR"] = witness_dir
+    else:
+        # An operator's ambient witness env must not leak into a soak
+        # that did not ask for it (the overhead would skew timings).
+        env.pop("COLEARN_LOCK_WITNESS", None)
+        env.pop("COLEARN_LOCK_WITNESS_DIR", None)
 
     fleet = _Fleet(workdir, env)
     watchdog = threading.Timer(timeout_s, fleet.kill_all)
@@ -874,6 +888,8 @@ def _run_async_fleet(
 
     recs = [records[a] for a in sorted(records)]
     return {
+        "lock_witness": (_collect_lockwitness(witness_dir)
+                         if lock_witness else {"enabled": False}),
         "aggregations_run": len(recs),
         "records": recs,
         "version_monotonic": version_monotonic,
@@ -885,6 +901,40 @@ def _run_async_fleet(
         "events": events,
         "exit_code": rc,
         "workdir": workdir,
+    }
+
+
+def _collect_lockwitness(witness_dir: str) -> dict:
+    """Merge the fleet's per-pid ``lockwitness-*.json`` dumps into one
+    gateable summary: report count, total inversions/unguarded (with the
+    offending records inlined for the operator), and the acquire volume
+    that vouches the witness actually saw traffic."""
+    reports = []
+    skipped = 0
+    if os.path.isdir(witness_dir):
+        for name in sorted(os.listdir(witness_dir)):
+            if not (name.startswith("lockwitness-")
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(witness_dir, name)) as f:
+                    reports.append(json.load(f))
+            except (OSError, ValueError):
+                # An unparseable dump (process died mid-write) is not a
+                # witnessed bug; the skipped count exposes the gap.
+                skipped += 1
+    inversions = [inv for r in reports for inv in r.get("inversions", [])]
+    unguarded = [u for r in reports for u in r.get("unguarded", [])]
+    return {
+        "enabled": True,
+        "reports": len(reports),
+        "reports_unparseable": skipped,
+        "acquires": sum(int(r.get("acquires", 0)) for r in reports),
+        "guarded_ops": sum(int(r.get("guarded_ops", 0)) for r in reports),
+        "inversions": len(inversions),
+        "unguarded": len(unguarded),
+        "inversion_records": inversions,
+        "unguarded_records": unguarded,
     }
 
 
@@ -912,6 +962,7 @@ def run_async_soak(
     seed: int = 0,
     loss_tol: float = 0.75,
     log_fn: Optional[Callable[[dict], None]] = None,
+    lock_witness: bool = False,
 ) -> dict:
     """Buffered-async chaos gate: SIGKILL the async coordinator
     mid-aggregation, relaunch with ``--resume``, and hold the recovered
@@ -961,13 +1012,15 @@ def run_async_soak(
         workdir=os.path.join(workdir, "faulted"),
         round_timeout=round_timeout, enroll_timeout=enroll_timeout,
         timeout_s=timeout_s, seed=seed,
-        fault_plan=_async_fault_plan() if kill else None, log_fn=log_fn)
+        fault_plan=_async_fault_plan() if kill else None, log_fn=log_fn,
+        lock_witness=lock_witness)
     baseline = _run_async_fleet(
         aggregations=aggregations, n_workers=n_workers,
         buffer_size=buffer_size, kills=[],
         workdir=os.path.join(workdir, "baseline"),
         round_timeout=round_timeout, enroll_timeout=enroll_timeout,
-        timeout_s=timeout_s, seed=seed, fault_plan=None, log_fn=log_fn)
+        timeout_s=timeout_s, seed=seed, fault_plan=None, log_fn=log_fn,
+        lock_witness=lock_witness)
 
     # RDP replay: the deduplicated record stream IS the final
     # coordinator's history (LAST record per aggregation wins, exactly
@@ -1060,8 +1113,29 @@ def run_async_soak(
         "flight_missing": faulted["flight_missing"],
         "kills": faulted["kills"],
         "records": faulted["records"],
+        "lock_witness": _merge_lockwitness(faulted["lock_witness"],
+                                           baseline["lock_witness"]),
         "workdir": workdir,
     }
+
+
+def _merge_lockwitness(*parts: dict) -> dict:
+    """Fold the per-fleet witness summaries (faulted + baseline/oracle)
+    into the one entry the chaos gate reads."""
+    if not any(p.get("enabled") for p in parts):
+        return {"enabled": False}
+    merged = {"enabled": True, "reports": 0, "acquires": 0,
+              "guarded_ops": 0, "inversions": 0, "unguarded": 0,
+              "inversion_records": [], "unguarded_records": []}
+    for p in parts:
+        if not p.get("enabled"):
+            continue
+        for k in ("reports", "acquires", "guarded_ops",
+                  "inversions", "unguarded"):
+            merged[k] += int(p.get(k, 0))
+        merged["inversion_records"] += list(p.get("inversion_records", []))
+        merged["unguarded_records"] += list(p.get("unguarded_records", []))
+    return merged
 
 
 def run_tree_async_soak(
@@ -1076,6 +1150,7 @@ def run_tree_async_soak(
     seed: int = 0,
     loss_tol: float = 0.75,
     log_fn: Optional[Callable[[dict], None]] = None,
+    lock_witness: bool = False,
 ) -> dict:
     """Tree-async chaos gate: buffered-async THROUGH the aggregator
     tree, with an aggregator SIGKILLed mid-aggregation (and left dead)
@@ -1132,14 +1207,14 @@ def run_tree_async_soak(
         workdir=os.path.join(workdir, "faulted"),
         round_timeout=round_timeout, enroll_timeout=enroll_timeout,
         timeout_s=timeout_s, seed=seed, n_aggregators=2,
-        fault_plan=None, log_fn=log_fn)
+        fault_plan=None, log_fn=log_fn, lock_witness=lock_witness)
     oracle = _run_async_fleet(
         aggregations=aggregations, n_workers=n_workers,
         buffer_size=buffer_size, kills=[],
         workdir=os.path.join(workdir, "oracle"),
         round_timeout=round_timeout, enroll_timeout=enroll_timeout,
         timeout_s=timeout_s, seed=seed, n_aggregators=2,
-        fault_plan=None, log_fn=log_fn)
+        fault_plan=None, log_fn=log_fn, lock_witness=lock_witness)
 
     import math as _math
 
@@ -1225,5 +1300,7 @@ def run_tree_async_soak(
         "flight_missing": faulted["flight_missing"],
         "kills": faulted["kills"],
         "records": faulted["records"],
+        "lock_witness": _merge_lockwitness(faulted["lock_witness"],
+                                           oracle["lock_witness"]),
         "workdir": workdir,
     }

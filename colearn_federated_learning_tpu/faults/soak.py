@@ -110,7 +110,7 @@ def run_soak(rounds: int = 10, n_workers: int = 4,
     reg = telemetry.get_registry()
     # Iterates a catalog-declared tuple (SOAK_DELTA_COUNTERS): every name
     # is validated at declaration, so the non-literal lookup is safe.
-    before = {name: reg.counter(name).value  # colearn: noqa(CL005)
+    before = {name: reg.counter(name).value  # colearn: noqa(CL005): names from the catalog-declared counter tuple
               for name in _COUNTERS}
     _LABELED = "fault.injected_total{"
     labeled_before = {k: v for k, v in reg.snapshot().items()
@@ -181,7 +181,7 @@ def run_soak(rounds: int = 10, n_workers: int = 4,
         "per_client_acc": per_client.get("per_client", {}),
         "counters": {
             # Same catalog-declared tuple as `before` above.
-            name: reg.counter(name).value - before[name]  # colearn: noqa(CL005)
+            name: reg.counter(name).value - before[name]  # colearn: noqa(CL005): names from the catalog-declared counter tuple
             for name in _COUNTERS
         },
         # Per-(device, kind) injection deltas, worst offender first — the
@@ -317,7 +317,7 @@ def run_secure_soak(rounds: int = 6, n_workers: int = 5,
     plan_plain = oracle_plan(plan)
 
     reg = telemetry.get_registry()
-    before = {name: reg.counter(name).value  # colearn: noqa(CL005)
+    before = {name: reg.counter(name).value  # colearn: noqa(CL005): names from the catalog-declared counter tuple
               for name in _SECURE_COUNTERS}
 
     def flat(coord) -> np.ndarray:
@@ -393,7 +393,7 @@ def run_secure_soak(rounds: int = 6, n_workers: int = 5,
                            if r.get("skipped_quorum")],
         "counters": {
             # Catalog-declared tuple (SECURE_SOAK_DELTA_COUNTERS).
-            name: reg.counter(name).value - before[name]  # colearn: noqa(CL005)
+            name: reg.counter(name).value - before[name]  # colearn: noqa(CL005): names from the catalog-declared counter tuple
             for name in _SECURE_COUNTERS
         },
         "faults_fired": dict(plan.fired) if plan is not None else {},

@@ -69,7 +69,7 @@ def test_cl001_suppression(tmp_path):
 
         @jax.jit
         def step(x):
-            print("trace marker")  # colearn: noqa(CL001)
+            print("trace marker")  # colearn: noqa(CL001): test fixture
             return x
     """, relpath="pkg/fed/mod.py", rules=["CL001"])
     assert res.findings == [] and res.suppressed == 1
@@ -126,7 +126,7 @@ def test_cl002_only_applies_under_comm(tmp_path):
 def test_cl002_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def accept_forever(srv):
-            return srv.accept()  # colearn: noqa(CL002)
+            return srv.accept()  # colearn: noqa(CL002): test fixture
     """)
     assert res.findings == [] and res.suppressed == 1
 
@@ -164,7 +164,7 @@ def test_cl003_suppression(tmp_path):
         def teardown(sock):
             try:
                 sock.close()
-            except OSError:  # colearn: noqa(CL003)
+            except OSError:  # colearn: noqa(CL003): test fixture
                 pass
     """)
     assert res.findings == [] and res.suppressed == 1
@@ -200,7 +200,7 @@ def test_cl004_suppression(tmp_path):
         import time
 
         def stamp():
-            return time.time()  # colearn: noqa(CL004)
+            return time.time()  # colearn: noqa(CL004): test fixture
     """, relpath="pkg/faults/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
@@ -235,7 +235,7 @@ def test_cl005_flags_fstring_with_unknown_prefix(tmp_path):
 def test_cl005_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def bump(registry):
-            registry.counter("scratch.local_only").inc()  # colearn: noqa(CL005)
+            registry.counter("scratch.local_only").inc()  # colearn: noqa(CL005): test fixture
     """, relpath="pkg/fed/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
@@ -254,7 +254,7 @@ def test_cl005_flags_non_literal_metric_name(tmp_path):
 def test_cl005_non_literal_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def snapshot(registry, names):
-            return {n: registry.counter(n).value  # colearn: noqa(CL005)
+            return {n: registry.counter(n).value  # colearn: noqa(CL005): test fixture
                     for n in names}
     """, relpath="pkg/fed/mod.py")
     assert res.findings == [] and res.suppressed == 1
@@ -287,7 +287,7 @@ def test_cl006_suppression(tmp_path):
 
         @jax.jit
         def step(x):
-            return float(x)  # colearn: noqa(CL006)
+            return float(x)  # colearn: noqa(CL006): test fixture
     """, relpath="pkg/fed/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
@@ -341,7 +341,7 @@ def test_cl007_suppression(tmp_path):
 
         def dump(devs, params):
             for d in devs:  # colearn: hot
-                save_pytree_npz(d.path, params)  # colearn: noqa(CL007)
+                save_pytree_npz(d.path, params)  # colearn: noqa(CL007): test fixture
     """)
     assert res.findings == [] and res.suppressed == 1
 
@@ -404,7 +404,7 @@ def test_cl008_ignores_reads_and_appends(tmp_path):
 def test_cl008_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def scratch(path, blob):
-            with open(path, "wb") as f:  # colearn: noqa(CL008)
+            with open(path, "wb") as f:  # colearn: noqa(CL008): test fixture
                 f.write(blob)
     """, relpath="pkg/fed/offline.py")
     assert res.findings == [] and res.suppressed == 1
@@ -470,7 +470,7 @@ def test_cl009_ignores_unmarked_and_non_fleetsim_loops(tmp_path):
 def test_cl009_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def debug_round(cohort_ids, train_one):
-            for device_id in cohort_ids:  # colearn: hot  # colearn: noqa(CL009)
+            for device_id in cohort_ids:  # colearn: hot  # colearn: noqa(CL009): test fixture
                 train_one(device_id)
     """, relpath="pkg/fleetsim/mod.py")
     assert res.findings == [] and res.suppressed == 1
@@ -532,7 +532,7 @@ def test_cl010_exempts_cli_scripts_and_main_guards(tmp_path):
 def test_cl010_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def report(x):
-            print(x)  # colearn: noqa(CL010)
+            print(x)  # colearn: noqa(CL010): test fixture
     """, relpath="pkg/fed/mod.py")
     assert res.findings == [] and res.suppressed == 1
 
@@ -604,7 +604,7 @@ def test_cl011_suppression(tmp_path):
         from pkg.privacy.secure_agg import mask_scalar
 
         def debug_mask(xs, key, me, partners, rnd):
-            for p in partners:  # colearn: hot  # colearn: noqa(CL011)
+            for p in partners:  # colearn: hot  # colearn: noqa(CL011): test fixture
                 xs = mask_scalar(xs, key, me, p, rnd)
             return xs
     """, relpath="pkg/privacy/mod.py")
@@ -674,7 +674,7 @@ def test_cl012_suppression(tmp_path):
         import numpy as np
 
         def stage(delta, w):  # colearn: hot
-            host = jax.tree.map(np.asarray, delta)  # colearn: noqa(CL012)
+            host = jax.tree.map(np.asarray, delta)  # colearn: noqa(CL012): test fixture
             return scale(host, w)
     """, relpath="pkg/comm/aggregation.py")
     assert res.findings == [] and res.suppressed == 1
@@ -734,7 +734,7 @@ def test_cl013_suppression(tmp_path):
         from pkg.fed import compression
 
         def add(self, meta, delta):  # colearn: hot
-            dense = compression.decompress_delta(  # colearn: noqa(CL013)
+            dense = compression.decompress_delta(  # colearn: noqa(CL013): test fixture
                 delta, meta, shapes=self.shapes)
             return self.stage(dense)
     """, relpath="pkg/comm/aggregation.py", rules=["CL013"])
@@ -818,7 +818,7 @@ def test_cl014_suppression(tmp_path):
         def drain(self, q):  # colearn: hot
             t0 = time.monotonic()
             q.drain()
-            lag = time.monotonic() - t0  # colearn: noqa(CL014)
+            lag = time.monotonic() - t0  # colearn: noqa(CL014): test fixture
             return lag
     """, relpath="pkg/comm/worker.py", rules=["CL014"])
     assert res.findings == [] and res.suppressed == 1
@@ -876,7 +876,7 @@ def test_cl015_suppression(tmp_path):
 
         def settle(self):
             for _ in range(3):
-                time.sleep(0.01)  # colearn: noqa(CL015)
+                time.sleep(0.01)  # colearn: noqa(CL015): test fixture
     """, relpath="pkg/comm/transport.py", rules=["CL015"])
     assert res.findings == [] and res.suppressed == 1
 
@@ -933,7 +933,7 @@ def test_cl016_suppression(tmp_path):
     res = run_lint(tmp_path, """
         def _round(self, r):
             rec = {"round": r}
-            rec["experimental_key"] = 1  # colearn: noqa(CL016)
+            rec["experimental_key"] = 1  # colearn: noqa(CL016): test fixture
             return rec
     """, relpath="pkg/comm/coordinator.py", rules=["CL016"])
     assert res.findings == [] and res.suppressed == 1

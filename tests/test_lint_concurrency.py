@@ -1,0 +1,520 @@
+"""Concurrency lint family (CL017–CL021) + the machinery that rode in
+with it: one positive / negative / suppression fixture per rule, the
+lock-order-cycle construction on a raw ClassLockIndex, the CL022
+reason-required contract on suppressions, and the SARIF reporter
+round-trip."""
+
+import ast
+import json
+import textwrap
+
+from colearn_federated_learning_tpu.analysis import lock_regions, reporters
+from colearn_federated_learning_tpu.analysis.engine import (
+    LintConfig,
+    LintEngine,
+    write_baseline,
+)
+from colearn_federated_learning_tpu.cli import main as cli_main
+
+
+def run_lint(tmp_path, source, relpath="pkg/comm/mod.py", rules=None,
+             baseline=""):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    eng = LintEngine(config=LintConfig(enable=rules), root=str(tmp_path))
+    return eng.run([str(path)], baseline_path=baseline)
+
+
+def rule_ids(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ------------------------------------------------------------- CL017 ----
+_CL017_RACY = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = {}
+
+        def start(self):
+            threading.Thread(target=self._loop).start()
+
+        def put(self, k, v):
+            with self._lock:
+                self._state[k] = v
+
+        def size(self):
+            with self._lock:
+                return len(self._state)
+
+        def _loop(self):
+            return self._state.get("x")%s
+"""
+
+
+def test_cl017_flags_bare_access_on_thread_reachable_path(tmp_path):
+    res = run_lint(tmp_path, _CL017_RACY % "", rules=["CL017"])
+    assert rule_ids(res) == ["CL017"]
+    (f,) = res.findings
+    assert "_state" in f.message and "_lock" in f.message
+    assert "_loop" in f.message
+
+
+def test_cl017_suppression(tmp_path):
+    res = run_lint(tmp_path,
+                   _CL017_RACY % "  # colearn: noqa(CL017): test fixture",
+                   rules=["CL017"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl017_quiet_when_access_is_locked(tmp_path):
+    res = run_lint(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def put(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+
+            def size(self):
+                with self._lock:
+                    return len(self._state)
+
+            def _loop(self):
+                with self._lock:
+                    return self._state.get("x")
+    """, rules=["CL017"])
+    assert res.findings == []
+
+
+def test_cl017_quiet_off_thread(tmp_path):
+    # Same bare access, but nothing ever hands a method to another
+    # thread: single-threaded classes are not in scope.
+    res = run_lint(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+
+            def size(self):
+                with self._lock:
+                    return len(self._state)
+
+            def peek(self):
+                return self._state.get("x")
+    """, rules=["CL017"])
+    assert res.findings == []
+
+
+def test_cl017_guarded_by_annotation_overrides_counting(tmp_path):
+    # One locked access is below the >=2 inference threshold; the
+    # explicit guarded-by annotation pins the contract anyway.
+    res = run_lint(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}  # colearn: guarded-by(_lock)
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def put(self, k, v):
+                with self._lock:
+                    self._state[k] = v
+
+            def _loop(self):
+                return self._state.get("x")
+    """, rules=["CL017"])
+    assert rule_ids(res) == ["CL017"]
+
+
+def test_cl017_scoped_to_threaded_dirs(tmp_path):
+    res = run_lint(tmp_path, _CL017_RACY % "", relpath="pkg/fed/mod.py",
+                   rules=["CL017"])
+    assert res.findings == []
+
+
+# ------------------------------------------------------------- CL018 ----
+def test_cl018_flags_opposite_nesting_order(tmp_path):
+    res = run_lint(tmp_path, """
+        import threading
+
+        class Duo:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def rev(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """, rules=["CL018"])
+    assert rule_ids(res) == ["CL018"]
+    assert "_a_lock -> _b_lock -> _a_lock" in res.findings[0].message
+
+
+def test_cl018_quiet_on_consistent_order(tmp_path):
+    res = run_lint(tmp_path, """
+        import threading
+
+        class Duo:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def also_fwd(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+    """, rules=["CL018"])
+    assert res.findings == []
+
+
+def test_lock_order_cycle_construction():
+    # The graph machinery directly: three locks in a rotating order
+    # build the 3-ring, reported once in canonical rotation.
+    tree = ast.parse(textwrap.dedent("""
+        class Tri:
+            def __init__(self):
+                import threading
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._c_lock = threading.Lock()
+
+            def ab(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def bc(self):
+                with self._b_lock:
+                    with self._c_lock:
+                        pass
+
+            def ca(self):
+                with self._c_lock:
+                    with self._a_lock:
+                        pass
+    """))
+    classdef = tree.body[0]
+    idx = lock_regions.ClassLockIndex(classdef, comments={})
+    assert idx.locks == {"_a_lock", "_b_lock", "_c_lock"}
+    assert ("_a_lock", "_b_lock") in idx.edges
+    assert idx.cycles() == [["_a_lock", "_b_lock", "_c_lock"]]
+
+
+# ------------------------------------------------------------- CL019 ----
+def test_cl019_flags_sleep_and_broker_rpc_under_lock(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+        import threading
+
+        from pkg.broker import BrokerClient
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def refresh(self):
+                with self._lock:
+                    return BrokerClient("h", 1, timeout=5.0)
+    """, rules=["CL019"])
+    assert rule_ids(res) == ["CL019"]
+    assert len(res.findings) == 2
+
+
+def test_cl019_quiet_outside_lock_and_for_own_cv_wait(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def spin(self):
+                time.sleep(0.1)
+                with self._lock:
+                    pass
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait(1.0)
+    """, rules=["CL019"])
+    assert res.findings == []
+
+
+def test_cl019_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        import time
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    time.sleep(0.1)  # colearn: noqa(CL019): test fixture
+    """, rules=["CL019"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL020 ----
+def test_cl020_flags_wait_outside_predicate_loop(tmp_path):
+    res = run_lint(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    self._cv.wait(1.0)
+    """, rules=["CL020"])
+    assert rule_ids(res) == ["CL020"]
+
+
+def test_cl020_quiet_in_while_loop_and_wait_for(tmp_path):
+    res = run_lint(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait(1.0)
+
+            def wait_pred(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self._ready, 1.0)
+    """, rules=["CL020"])
+    assert res.findings == []
+
+
+# ------------------------------------------------------------- CL021 ----
+_CL021_FANOUT = """
+    import threading
+
+    class T:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._subs = {}
+
+        def add(self, k, v):
+            with self._lock:
+                self._subs[k] = v
+
+        def drop(self, k):
+            with self._lock:
+                self._subs.pop(k, None)
+
+        def fanout(self):
+            for s in %s:
+                s()
+"""
+
+
+def test_cl021_flags_unlocked_iteration(tmp_path):
+    res = run_lint(tmp_path, _CL021_FANOUT % "self._subs.values()",
+                   rules=["CL021"])
+    assert rule_ids(res) == ["CL021"]
+    assert "_subs" in res.findings[0].message
+
+
+def test_cl021_quiet_under_lock_and_for_snapshots(tmp_path):
+    locked = run_lint(tmp_path, """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._subs = {}
+
+            def add(self, k, v):
+                with self._lock:
+                    self._subs[k] = v
+
+            def drop(self, k):
+                with self._lock:
+                    self._subs.pop(k, None)
+
+            def fanout(self):
+                with self._lock:
+                    for s in self._subs.values():
+                        s()
+    """, rules=["CL021"])
+    assert locked.findings == []
+    snapshot = run_lint(tmp_path,
+                        _CL021_FANOUT % "list(self._subs.values())",
+                        relpath="pkg/comm/snap.py", rules=["CL021"])
+    assert snapshot.findings == []
+
+
+def test_cl021_comprehension_iteration_is_flagged(tmp_path):
+    res = run_lint(tmp_path, """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._subs = {}
+
+            def add(self, k, v):
+                with self._lock:
+                    self._subs[k] = v
+
+            def drop(self, k):
+                with self._lock:
+                    self._subs.pop(k, None)
+
+            def names(self):
+                return [k for k in self._subs]
+    """, rules=["CL021"])
+    assert rule_ids(res) == ["CL021"]
+
+
+# ------------------------------------------------------------- CL022 ----
+_JIT_PRINT = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("trace")%s
+        return x
+"""
+
+
+def test_cl022_flags_bare_live_suppression(tmp_path):
+    res = run_lint(tmp_path, _JIT_PRINT % "  # colearn: noqa(CL001)",
+                   relpath="pkg/fed/mod.py", rules=["CL001"])
+    assert rule_ids(res) == ["CL022"]
+    assert res.suppressed == 1
+
+
+def test_cl022_quiet_with_reason(tmp_path):
+    res = run_lint(tmp_path,
+                   _JIT_PRINT % "  # colearn: noqa(CL001): test fixture",
+                   relpath="pkg/fed/mod.py", rules=["CL001"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl022_blanket_noqa_is_exempt(tmp_path):
+    res = run_lint(tmp_path, _JIT_PRINT % "  # colearn: noqa",
+                   relpath="pkg/fed/mod.py", rules=["CL001"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+def test_cl022_dead_bare_noqa_gets_cl000_only(tmp_path):
+    res = run_lint(tmp_path, """
+        def quiet():
+            return 1  # colearn: noqa(CL001)
+    """, relpath="pkg/fed/mod.py", rules=["CL001"])
+    assert rule_ids(res) == ["CL000"]
+
+
+# -------------------------------------------------------------- SARIF ----
+def test_sarif_round_trip(tmp_path):
+    res = run_lint(tmp_path, _JIT_PRINT % "", relpath="pkg/fed/mod.py",
+                   rules=["CL001"])
+    assert len(res.findings) == 1
+    doc = json.loads(reporters.render_sarif(res))
+    assert doc["version"] == "2.1.0"
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "colearn-lint"
+    (result,) = run["results"]
+    (finding,) = res.findings
+    assert result["ruleId"] == finding.rule == "CL001"
+    rules_table = run["tool"]["driver"]["rules"]
+    assert rules_table[result["ruleIndex"]]["id"] == "CL001"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == finding.path
+    assert loc["region"]["startLine"] == finding.line
+    assert (result["partialFingerprints"]["colearnFingerprint/v1"]
+            == finding.fingerprint())
+
+
+def test_sarif_clean_run_has_empty_results(tmp_path):
+    res = run_lint(tmp_path, "x = 1\n", relpath="pkg/fed/mod.py",
+                   rules=["CL001"])
+    doc = json.loads(reporters.render_sarif(res))
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_format_sarif(tmp_path, capsys):
+    path = tmp_path / "pkg" / "fed" / "mod.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent(_JIT_PRINT % ""))
+    rc = cli_main(["lint", str(path), "--root", str(tmp_path),
+                   "--rules", "CL001", "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["runs"][0]["results"][0]["ruleId"] == "CL001"
+
+
+# --------------------------------------------------------------- gate ----
+def test_gate_fails_on_nonempty_baseline(tmp_path, capsys):
+    path = tmp_path / "pkg" / "fed" / "mod.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent(_JIT_PRINT % ""))
+    eng = LintEngine(config=LintConfig(enable=["CL001"]),
+                     root=str(tmp_path))
+    res = eng.run([str(path)], baseline_path="")
+    write_baseline(str(tmp_path / "lint_baseline.json"), res.findings)
+
+    rc = cli_main(["lint", str(path), "--root", str(tmp_path),
+                   "--rules", "CL001", "--gate"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "baseline" in err and "1 fingerprint(s)" in err
+
+
+def test_gate_passes_on_empty_baseline(tmp_path, capsys):
+    path = tmp_path / "pkg" / "fed" / "mod.py"
+    path.parent.mkdir(parents=True)
+    path.write_text("x = 1\n")
+    rc = cli_main(["lint", str(path), "--root", str(tmp_path),
+                   "--rules", "CL001", "--gate"])
+    capsys.readouterr()
+    assert rc == 0

@@ -1,0 +1,204 @@
+"""faults/lockwitness: off-mode zero-cost passthrough, seeded
+lock-order inversion detection, unguarded-access witnesses on guarded
+structures, Condition held-stack truthfulness across wait(), the
+per-pid JSON dump, and the procsoak report collectors."""
+
+import json
+import threading
+
+import pytest
+
+from colearn_federated_learning_tpu.faults import lockwitness, procsoak
+
+
+@pytest.fixture
+def witness_on(monkeypatch):
+    monkeypatch.setenv("COLEARN_LOCK_WITNESS", "1")
+    monkeypatch.delenv("COLEARN_LOCK_WITNESS_DIR", raising=False)
+    lockwitness.reset()
+    yield
+    lockwitness.reset()
+
+
+# ----------------------------------------------------------------- off --
+def test_off_mode_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv("COLEARN_LOCK_WITNESS", raising=False)
+    assert not isinstance(lockwitness.lock("x"), lockwitness.WitnessLock)
+    obj = {"a": 1}
+    assert lockwitness.guarded(obj, "t", lockwitness.lock("x")) is obj
+    assert lockwitness.report() == {"enabled": False}
+
+
+# ----------------------------------------------------------- inversion --
+def test_seeded_inversion_is_witnessed(witness_on):
+    a = lockwitness.lock("A")
+    b = lockwitness.lock("B")
+    with a:
+        with b:
+            pass                       # establishes A -> B
+    with b:
+        with a:                        # B -> A closes the ring
+            pass
+    rep = lockwitness.report()
+    assert rep["edges"] == ["A->B", "B->A"]
+    assert len(rep["inversions"]) == 1
+    assert rep["inversions"][0]["edge"] == ["B", "A"]
+
+
+def test_inversion_witnessed_even_when_acquire_times_out(witness_on):
+    # The deadlock-shaped case: the second acquire BLOCKS (and here
+    # times out) — the attempt alone must record the inversion, since a
+    # real deadlock never reaches on_acquired.
+    a = lockwitness.lock("A")
+    b = lockwitness.lock("B")
+    with a:
+        with b:
+            pass
+    holder = threading.Event()
+    release = threading.Event()
+
+    def hold_a():
+        with a:
+            holder.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold_a)
+    t.start()
+    assert holder.wait(5.0)
+    with b:
+        got = a.acquire(timeout=0.05)
+        assert not got
+    release.set()
+    t.join(5.0)
+    rep = lockwitness.report()
+    assert len(rep["inversions"]) == 1
+
+
+def test_consistent_order_records_no_inversion(witness_on):
+    a = lockwitness.lock("A")
+    b = lockwitness.lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = lockwitness.report()
+    assert rep["edges"] == ["A->B"]
+    assert rep["inversions"] == []
+    assert rep["acquires"] == 6
+
+
+# ------------------------------------------------------------- guarded --
+def test_guarded_dict_stamps_unguarded_access(witness_on):
+    lk = lockwitness.lock("G")
+    d = lockwitness.guarded({}, "t._d", lk)
+    with lk:
+        d["a"] = 1                     # guarded: clean
+    assert lockwitness.report()["unguarded"] == []
+    d["b"] = 2                         # bare: witnessed
+    for _ in d:
+        pass
+    rep = lockwitness.report()
+    ops = [u["op"] for u in rep["unguarded"]]
+    assert ops == ["setitem", "iter"]
+    assert all(u["structure"] == "t._d" for u in rep["unguarded"])
+    # the stamp names the CALLER site, not the wrapper internals
+    assert "test_lockwitness.py" in rep["unguarded"][0]["site"]
+
+
+def test_guarded_set_and_list(witness_on):
+    lk = lockwitness.lock("G")
+    s = lockwitness.guarded(set(), "t._s", lk)
+    xs = lockwitness.guarded([], "t._l", lk)
+    with lk:
+        s.add(1)
+        xs.append(2)
+    assert lockwitness.report()["unguarded"] == []
+    s.add(3)
+    xs.append(4)
+    assert len(lockwitness.report()["unguarded"]) == 2
+
+
+def test_condition_wait_keeps_held_stack_truthful(witness_on):
+    cv = lockwitness.condition("CV")
+    other = lockwitness.lock("L")
+    fired = []
+
+    def notifier():
+        with cv:
+            fired.append(True)
+            cv.notify()
+
+    with cv:
+        t = threading.Timer(0.05, notifier)
+        t.start()
+        # wait() releases the witnessed lock through _release_save: if
+        # the held stack went stale the notifier's acquire would record
+        # a bogus CV -> CV edge or deadlock; it must just succeed.
+        assert cv.wait_for(lambda: fired, timeout=5.0)
+        with other:                    # edge CV -> L, no inversion
+            pass
+    rep = lockwitness.report()
+    assert rep["inversions"] == []
+    assert "CV->L" in rep["edges"]
+
+
+# ---------------------------------------------------------------- dump --
+def test_atexit_dump_writes_per_pid_json(witness_on, monkeypatch, tmp_path):
+    out = tmp_path / "lw"
+    monkeypatch.setenv("COLEARN_LOCK_WITNESS_DIR", str(out))
+    lockwitness.reset()
+    a = lockwitness.lock("A")
+    with a:
+        pass
+    lockwitness._WITNESS._dump()
+    (path,) = sorted(out.glob("lockwitness-*.json"))
+    doc = json.loads(path.read_text())
+    assert doc["acquires"] == 1 and doc["inversions"] == []
+
+
+# ------------------------------------------------- procsoak collectors --
+def _fake_report(**over):
+    doc = {"enabled": True, "pid": 1, "acquires": 10, "guarded_ops": 5,
+           "edges": [], "inversions": [], "unguarded": []}
+    doc.update(over)
+    return doc
+
+
+def test_collect_lockwitness_aggregates_and_skips_garbage(tmp_path):
+    d = tmp_path / "lockwitness"
+    d.mkdir()
+    (d / "lockwitness-1.json").write_text(json.dumps(_fake_report()))
+    (d / "lockwitness-2.json").write_text(json.dumps(_fake_report(
+        pid=2, inversions=[{"edge": ["B", "A"]}],
+        unguarded=[{"structure": "x", "op": "iter"}])))
+    (d / "lockwitness-3.json").write_text("{not json")
+    (d / "flight-9.json").write_text("{}")      # foreign file: ignored
+    lw = procsoak._collect_lockwitness(str(d))
+    assert lw["enabled"] and lw["reports"] == 2
+    assert lw["reports_unparseable"] == 1
+    assert lw["acquires"] == 20 and lw["guarded_ops"] == 10
+    assert lw["inversions"] == 1 and lw["unguarded"] == 1
+    assert lw["inversion_records"] == [{"edge": ["B", "A"]}]
+
+
+def test_collect_lockwitness_missing_dir(tmp_path):
+    lw = procsoak._collect_lockwitness(str(tmp_path / "nope"))
+    assert lw["enabled"] and lw["reports"] == 0 and lw["inversions"] == 0
+
+
+def test_merge_lockwitness():
+    off = procsoak._merge_lockwitness({"enabled": False},
+                                      {"enabled": False})
+    assert off == {"enabled": False}
+    merged = procsoak._merge_lockwitness(
+        {"enabled": True, "reports": 4, "acquires": 100, "guarded_ops": 7,
+         "inversions": 1, "unguarded": 0,
+         "inversion_records": [{"edge": ["B", "A"]}],
+         "unguarded_records": []},
+        {"enabled": False},
+        {"enabled": True, "reports": 4, "acquires": 50, "guarded_ops": 3,
+         "inversions": 0, "unguarded": 2, "inversion_records": [],
+         "unguarded_records": [{"op": "iter"}, {"op": "pop"}]})
+    assert merged["reports"] == 8 and merged["acquires"] == 150
+    assert merged["inversions"] == 1 and merged["unguarded"] == 2
+    assert len(merged["unguarded_records"]) == 2
