@@ -45,6 +45,7 @@ COUNTERS = (
     "comm.bytes_saved_downlink",     # delta vs full-params payload bytes
     "comm.bytes_saved_uplink",       # compressed vs dense train-reply bytes
     "comm.uplink_densify_avoided_total",  # contributions folded sparse (O(k))
+    "comm.fold_device_total",           # contributions folded on-device
     "comm.resync_total",             # worker cache misses → full re-send
     # sharded server plane (parallel/partition.py, comm/downlink.py):
     # per-chip replication bytes the gather-free downlink never

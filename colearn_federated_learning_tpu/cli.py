@@ -126,6 +126,13 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                         "per-slice buffer targets one partial ship per "
                         "this many seconds (buffer depth auto-sizes from "
                         "the slice's measured arrival rate)")
+    p.add_argument("--fold-device", action="store_true", default=None,
+                   help="device-resident fold (ops/fold_kernel.py): "
+                        "server folds run through the fused batched "
+                        "kernel — in-kernel topk8 dequant + weighting + "
+                        "scatter-add, one compile per model — instead "
+                        "of the per-update host-numpy scatter; bitwise "
+                        "identical to the host fold")
     p.add_argument("--compress-down", default=None,
                    choices=["none", "int8", "topk"],
                    help="DOWNLINK broadcast compression (synchronous "
@@ -283,7 +290,8 @@ _RUN_KEYS = {"backend", "seed", "tp_size", "eval_every", "log_every",
              "evict_after", "worker_enroll_timeout", "comm_retries",
              "comm_backoff_base", "comm_backoff_max", "fault_plan",
              "fault_seed", "num_aggregators", "agg_heartbeat_timeout",
-             "agg_buffer_interval_s", "health_dir", "learn_observe"}
+             "agg_buffer_interval_s", "health_dir", "learn_observe",
+             "fold_device"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:

@@ -33,6 +33,46 @@ class _SparseStage:
         self.leaves = leaves
 
 
+class _RawSparseStage:
+    """One topk contribution staged for the DEVICE fold: per slot (leaf
+    or leaf-shard, flatten order), ``(flat_idx int64, raw_values,
+    dequant_scale)`` — topk8 values stay int8 so the fused kernel decodes
+    them in-kernel; plain topk values stay float32 with scale 1.0.  The
+    aggregation weight is NOT pre-applied (the kernel multiplies it in
+    host order: ``(value * scale) * weight``)."""
+
+    __slots__ = ("slots", "vals_dtype")
+
+    def __init__(self, slots: list, vals_dtype: Any):
+        self.slots = slots
+        self.vals_dtype = vals_dtype
+
+
+def _own_leaf(leaf: Any) -> np.ndarray:
+    """Staging-time ownership normalization: a writable, C-contiguous
+    array the fold can mutate in place.  Copies AT MOST once per staged
+    leaf — the hoisted replacement for the old per-scatter defensive copy
+    in ``_scatter_fold``."""
+    a = np.asarray(leaf)
+    if not (a.flags.writeable and a.flags.c_contiguous):
+        a = np.array(a)
+    return a
+
+
+def _merge_dense(acc: Any, contrib: Any) -> Any:
+    """Elementwise host add for the dense fold, in place when the
+    accumulator permits (staged leaves are owned and single-use, so
+    mutating them is safe) — bitwise identical to the jnp ``tree_add`` it
+    replaces, but the result stays OWNED writable numpy, so a sparse
+    scatter landing on it later never has to copy."""
+    def add(a, c):
+        a = np.asarray(a)
+        if a.flags.writeable and a.dtype == np.result_type(a, c):
+            return np.add(a, c, out=a)
+        return np.add(a, c)
+    return jax.tree.map(add, acc, contrib)
+
+
 class UpdateFolder:
     """Accumulate weighted client deltas; ``mean()`` is None-safe."""
 
@@ -131,7 +171,8 @@ class StreamingFolder(UpdateFolder):
 
     def __init__(self, shapes: Any, order: Optional[Sequence[str]] = None,
                  placement: Optional[Any] = None,
-                 slices: Optional[Sequence[Sequence[str]]] = None):
+                 slices: Optional[Sequence[Sequence[str]]] = None,
+                 device_fold: bool = False):
         super().__init__(shapes)
         self._order = list(order) if order is not None else None
         self._staged: dict[str, tuple[float, Any, float]] = {}
@@ -142,6 +183,17 @@ class StreamingFolder(UpdateFolder):
         self.folded_ids: list[str] = []
         self.densify_avoided = 0
         self._finalized = False
+        # Device-resident fold (--fold-device, ops/fold_kernel.py): topk
+        # contributions stage RAW (int8 + scale, weight unapplied) and
+        # each finalize block folds through the fused batched kernel —
+        # bitwise-pinned against this host path, which stays the parity
+        # oracle.  The kernel is fetched lazily (shape-fingerprint cache:
+        # one compile per MODEL, not per folder/round) and the batch cap
+        # is an internal knob the fold bench uses to price batch=1 vs K.
+        self._device_fold = bool(device_fold)
+        self._kernel = None
+        self._slot_meta: Optional[list] = None
+        self._fold_batch_max: Optional[int] = None
 
     def add(self, meta: dict, delta: Any,  # colearn: hot
             weight: Optional[float] = None) -> float:
@@ -155,9 +207,11 @@ class StreamingFolder(UpdateFolder):
         if meta.get("compress") in compression.TOPK_SCHEMES:
             # Sparse-native staging: the wire's (indices, values) stay
             # sparse — O(k) copy + scale here, cohort-order scatter-add at
-            # finalize (topk8 values dequantize inside topk_leaf_arrays).
+            # finalize (topk8 values dequantize inside topk_leaf_arrays;
+            # the device fold defers even the dequant into the kernel).
             # No full-shape tensor is materialized per update.
-            contrib = self._stage_topk(delta, w)
+            contrib = (self._stage_topk_raw(delta, w) if self._device_fold
+                       else self._stage_topk(delta, w))
             self.densify_avoided += 1
             telemetry.get_registry().counter(
                 "comm.uplink_densify_avoided_total").inc()
@@ -166,10 +220,13 @@ class StreamingFolder(UpdateFolder):
             # signal); "none" already arrives dense.
             delta = compression.decompress_delta(  # colearn: noqa(CL013): int8/none payloads are inherently dense
                 delta, meta, shapes=self.shapes)
-            # Wire deltas are host numpy straight off the decode — the
-            # asarray normalizes dtypes/views, it cannot touch a device.
-            contrib = pytrees.tree_scale(
-                jax.tree.map(np.asarray, delta), w)  # colearn: noqa(CL012): wire deltas are host numpy, no device touch
+            # Per-leaf host scale: wire deltas are numpy straight off the
+            # decode, and the multiply hands the fold an OWNED, writable,
+            # C-contiguous contribution — the in-place scatter/merge
+            # downstream never needs a defensive copy.
+            leaves, treedef = jax.tree.flatten(delta)
+            contrib = jax.tree.unflatten(
+                treedef, [np.asarray(leaf) * w for leaf in leaves])
             if self._placement is not None:
                 # Shard-wise staging: each leaf becomes the tuple of its
                 # per-shard slices (uplink decode scattered symmetrically).
@@ -205,6 +262,32 @@ class StreamingFolder(UpdateFolder):
                 leaves.append([(idx, vals, tuple(np.shape(ref)))])
         return _SparseStage(leaves)
 
+    def _stage_topk_raw(self, wire_tree: Any, w: float) -> _RawSparseStage:
+        """Stage one topk wire tree RAW for the device fold: indices as
+        int64, values undecoded (int8 for topk8), per-leaf dequant scale
+        riding along — the kernel applies ``(value * scale) * weight``
+        itself, in exactly the host path's multiply order.  O(k) host
+        work, no dequant, no scale pass."""
+        from colearn_federated_learning_tpu.fed import compression
+
+        treedef = jax.tree.structure(self.shapes)
+        nodes = treedef.flatten_up_to(wire_tree)
+        slots: list = []
+        vdt = np.dtype(np.float32)
+        for pos, node in enumerate(nodes):
+            idx, vals, scale, _ = compression.topk_leaf_raw(node)
+            idx = np.ascontiguousarray(idx, np.int64)
+            vdt = vals.dtype
+            if self._placement is not None:
+                # Offset-adjusted per-shard partitioning preserves the
+                # raw value dtype (boolean masking never casts).
+                for li, lv, _shape in self._placement.partition_flat_indices(
+                        pos, idx, vals):
+                    slots.append((li, lv, scale))
+            else:
+                slots.append((idx, vals, scale))
+        return _RawSparseStage(slots, vdt)
+
     def add_partial(self, key: str, total_w: float, tree: Any,
                     loss_sum: float, count: int = 1) -> None:
         """Stage one PRE-FOLDED partial sum (an aggregator's slice fold):
@@ -220,7 +303,12 @@ class StreamingFolder(UpdateFolder):
         t0 = time.perf_counter()
         contrib = None
         if tree is not None:
-            contrib = jax.tree.map(np.asarray, tree)
+            # Ownership is normalized HERE, at staging (at most one copy
+            # per leaf, and only for read-only/non-contiguous inputs) —
+            # the fold's in-place scatter/merge relies on it.
+            leaves, treedef = jax.tree.flatten(tree)
+            contrib = jax.tree.unflatten(treedef,
+                                         [_own_leaf(l) for l in leaves])
             if self._placement is not None:
                 # Slicing commutes elementwise with the adds below, so the
                 # sharded combine stays bitwise equal to the replicated one.
@@ -258,11 +346,14 @@ class StreamingFolder(UpdateFolder):
         normalize a ``-0.0`` accumulator entry to ``+0.0`` — a corner the
         magnitude-topk codec never ships and the parity tests pin.
 
-        Accumulation stays in OWNED, C-contiguous host numpy (the dense
-        path's ``tree_add`` would hand back immutable jax buffers), so
-        the in-place scatter is safe; a non-writable leaf (only possible
-        when schemes are mixed within one cohort, which no config
-        produces) is copied once before the scatter."""
+        Accumulation stays in OWNED, writable, C-contiguous host numpy by
+        STAGING-TIME invariant: dense contributions are owned by their
+        scale multiply, partials by ``_own_leaf``, sharded slices by
+        ``slice_tree``'s ``ascontiguousarray``, and the dense merge
+        (``_merge_dense``) writes through numpy — so the in-place scatter
+        below is always safe.  The old per-scatter writability check/copy
+        is gone: normalization happens at most once per leaf, at staging,
+        never per fold step."""
         treedef = jax.tree.structure(self.shapes)
         if acc is None:
             out = []
@@ -282,9 +373,6 @@ class StreamingFolder(UpdateFolder):
             sharded = isinstance(acc, tuple)
             targets = list(acc) if sharded else [acc]
             for j, (arr, (idx, vals, _)) in enumerate(zip(targets, shards)):
-                if not (isinstance(arr, np.ndarray) and arr.flags.writeable
-                        and arr.flags.c_contiguous):
-                    arr = np.array(arr, np.float32)
                 # reshape(-1) of a C-contiguous array is a VIEW — the +=
                 # mutates the accumulator (and handles 0-d leaves, which
                 # reject direct fancy indexing).
@@ -296,7 +384,11 @@ class StreamingFolder(UpdateFolder):
     def _fold_block(self, ids: Sequence[str]) -> tuple[Any, float, float]:
         """Fold one block of staged ids sequentially from scratch —
         weighted sum, total weight and weighted loss all accumulate
-        block-locally (exactly what a slice aggregator computes)."""
+        block-locally (exactly what a slice aggregator computes).  The
+        dense merge runs through ``_merge_dense`` (host numpy, in place):
+        bit-identical to the jnp ``tree_add`` it replaces, but the
+        accumulator stays writable so an interleaved sparse scatter never
+        copies."""
         acc, tw, ls = None, 0.0, 0.0
         for cid in ids:
             w, contrib, loss_w = self._staged[cid]
@@ -304,10 +396,108 @@ class StreamingFolder(UpdateFolder):
                 acc = self._scatter_fold(acc, contrib)
             elif contrib is not None:
                 acc = (contrib if acc is None
-                       else pytrees.tree_add(acc, contrib))
+                       else _merge_dense(acc, contrib))
             tw += w
             ls += loss_w
         return acc, tw, ls
+
+    def _slot_layout(self) -> list:
+        """Per leaf (flatten order): the list of slot shapes the device
+        fold accumulates into — one per distinct shard under a placement
+        (``slice_tree``'s slice order), exactly one otherwise."""
+        if self._slot_meta is None:
+            refs = jax.tree.leaves(self.shapes)
+            if self._placement is None:
+                self._slot_meta = [[tuple(np.shape(r))] for r in refs]
+            else:
+                no_idx = np.zeros(0, np.int64)
+                no_val = np.zeros(0, np.float32)
+                self._slot_meta = [
+                    [tuple(shape) for _, _, shape in
+                     self._placement.partition_flat_indices(
+                         pos, no_idx, no_val)]
+                    for pos in range(len(refs))
+                ]
+        return self._slot_meta
+
+    def _dense_slots(self, contrib: Any) -> list:
+        """One staged dense/partial tree as the kernel's flat slot list
+        (views, not copies — staged leaves are C-contiguous)."""
+        slots = []
+        for leaf in jax.tree.structure(self.shapes).flatten_up_to(contrib):
+            for part in (leaf if isinstance(leaf, tuple) else (leaf,)):
+                slots.append(np.asarray(part).reshape(-1))
+        return slots
+
+    def _fold_block_device(self, ids: Sequence[str]) -> tuple:  # colearn: hot
+        """Device-resident block fold: batch the staged contributions
+        through the fused kernel (ops/fold_kernel.py) — sparse runs fold
+        as ONE batched scatter dispatch (in-kernel dequant + weighting),
+        dense runs as one batched add — and convert to host exactly once
+        at block end.  Runs split only at sparse/dense (or value-dtype)
+        boundaries, so the kernel's scan order is the cohort order and
+        the result is bitwise identical to :meth:`_fold_block`, the
+        parity oracle."""
+        from colearn_federated_learning_tpu import telemetry
+        from colearn_federated_learning_tpu.ops import fold_kernel
+
+        kernel = self._kernel
+        if kernel is None:
+            sizes = [int(np.prod(shape, dtype=np.int64)) if shape else 1
+                     for group in self._slot_layout() for shape in group]
+            kernel = self._kernel = fold_kernel.get_kernel(sizes)
+        acc = None
+        tw, ls, folded = 0.0, 0.0, 0
+        cap = self._fold_batch_max or len(ids) or 1
+        sparse_run: list = []
+        dense_run: list = []
+        run_dtype = None
+
+        def flush_sparse():
+            nonlocal acc
+            while sparse_run:
+                acc = kernel.fold_sparse(acc, sparse_run[:cap])
+                del sparse_run[:cap]
+
+        def flush_dense():
+            nonlocal acc
+            while dense_run:
+                acc = kernel.fold_dense(acc, dense_run[:cap])
+                del dense_run[:cap]
+
+        for cid in ids:
+            w, contrib, loss_w = self._staged[cid]
+            tw += w
+            ls += loss_w
+            if isinstance(contrib, _RawSparseStage):
+                if dense_run:
+                    flush_dense()
+                if sparse_run and run_dtype != contrib.vals_dtype:
+                    flush_sparse()
+                run_dtype = contrib.vals_dtype
+                sparse_run.append((np.float32(w), contrib.slots))
+                folded += 1
+            elif contrib is not None:
+                if sparse_run:
+                    flush_sparse()
+                dense_run.append(self._dense_slots(contrib))
+                folded += 1
+        flush_sparse()
+        flush_dense()
+        if folded:
+            telemetry.get_registry().counter(
+                "comm.fold_device_total").inc(folded)
+        if acc is None:
+            return None, tw, ls
+        leaves = kernel.to_host(acc)
+        it = iter(leaves)
+        out = []
+        for group in self._slot_layout():
+            parts = [next(it).reshape(shape) for shape in group]
+            out.append(tuple(parts) if self._placement is not None
+                       else parts[0])
+        tree = jax.tree.unflatten(jax.tree.structure(self.shapes), out)
+        return tree, tw, ls
 
     def finalize(self) -> None:
         """Sum the staged contributions in cohort order (idempotent).
@@ -338,10 +528,11 @@ class StreamingFolder(UpdateFolder):
                 blocks.append(stragglers)
             ids = [cid for blk in blocks for cid in blk]
         for blk in blocks:
-            acc, tw, ls = self._fold_block(blk)
+            acc, tw, ls = (self._fold_block_device(blk) if self._device_fold
+                           else self._fold_block(blk))
             if acc is not None:
                 self.wsum = (acc if self.wsum is None
-                             else pytrees.tree_add(self.wsum, acc))
+                             else _merge_dense(self.wsum, acc))
             self.total_w += tw
             self.loss_sum += ls
         self.folded_ids = ids

@@ -172,6 +172,9 @@ class AggregatorServer:
         )
 
         self.arrival = ArrivalEstimator()
+        # --fold-device: slice folds run through the fused device kernel
+        # (ops/fold_kernel.py); the host fold stays the parity oracle.
+        self._fold_device = bool(getattr(config.run, "fold_device", False))
         self._abuf_cv = lockwitness.condition(f"agg{agg_id}.abuf_cv")
         self._abuf_folder = None            # StreamingFolder | None
         self._abuf_shapes = None
@@ -276,7 +279,8 @@ class AggregatorServer:
         shapes = tree["factors"] if meta_in.get("lora") else tree
         with self._abuf_cv:
             self._abuf_shapes = shapes
-            self._abuf_folder = StreamingFolder(shapes)
+            self._abuf_folder = StreamingFolder(
+                shapes, device_fold=self._fold_device)
             self._abuf_entries = {}
             self._abuf_dedup = 0
             self._abuf_cv.notify_all()
@@ -387,7 +391,8 @@ class AggregatorServer:
                 StreamingFolder,
             )
 
-            self._abuf_folder = StreamingFolder(self._abuf_shapes)
+            self._abuf_folder = StreamingFolder(
+                self._abuf_shapes, device_fold=self._fold_device)
             self._abuf_entries = {}
             self._abuf_dedup = 0
         folder.finalize()
@@ -461,7 +466,8 @@ class AggregatorServer:
         # trees — the factors half is the fold template.
         order = [str(int(d[0])) for d in devices]
         shapes = tree["factors"] if meta_in.get("lora") else tree
-        folder = StreamingFolder(shapes, order=order)
+        folder = StreamingFolder(shapes, order=order,
+                                 device_fold=self._fold_device)
         stale: list[str] = []
         failed: list[str] = []
         worker_spans: list = []

@@ -239,6 +239,9 @@ class AsyncFederatedCoordinator:
                     np.shape(a)),
                 params,
             )
+        # --fold-device: buffer folds run through the fused device kernel
+        # (ops/fold_kernel.py); the host fold stays the parity oracle.
+        self._fold_device = bool(getattr(config.run, "fold_device", False))
         self.server_state = strategies.init_server_state(params, config.fed)
         if self._placement is not None:
             telemetry.get_registry().gauge(
@@ -1077,7 +1080,8 @@ class AsyncFederatedCoordinator:
         # makes the folder's sorted finalize reproduce the arrival-order
         # sum the dense UpdateFolder used to compute — bitwise.
         folder = StreamingFolder(self._shapes_np,
-                                 placement=self._placement)
+                                 placement=self._placement,
+                                 device_fold=self._fold_device)
         staleness: list[int] = []
         contributors: list[str] = []
         weights: list[float] = []
@@ -1309,7 +1313,8 @@ class AsyncFederatedCoordinator:
         self._start_dispatchers()
         t0 = time.perf_counter()
         folder = StreamingFolder(self._shapes_np,
-                                 placement=self._placement)
+                                 placement=self._placement,
+                                 device_fold=self._fold_device)
         discarded = 0
         mass_folded = 0.0
         mass_discarded = 0.0

@@ -201,6 +201,9 @@ class FederatedCoordinator:
         self._fold_shapes = (jax.tree.map(np.asarray, self._factors)
                              if self._lora else self._shapes_np)
         self._fold_placement = None if self._lora else self._placement
+        # --fold-device: round folds run through the fused device kernel
+        # (ops/fold_kernel.py); the host fold stays the parity oracle.
+        self._fold_device = bool(getattr(config.run, "fold_device", False))
         self.server_state = strategies.init_server_state(params, config.fed)
         if self._placement is not None:
             telemetry.get_registry().gauge(
@@ -827,7 +830,8 @@ class FederatedCoordinator:
             folder = StreamingFolder(
                 self._fold_shapes,
                 order=[f"slice:{i}" for i in range(len(slices))],
-                placement=self._fold_placement)
+                placement=self._fold_placement,
+                device_fold=self._fold_device)
             with self.tracer.span("broadcast_collect",
                                   cohort=len(cohort)) as collect_sp:
                 train_timeout = max(1.0, self.round_timeout
@@ -879,7 +883,8 @@ class FederatedCoordinator:
             folder = StreamingFolder(
                 self._fold_shapes,
                 order=[str(int(d.device_id)) for d in cohort],
-                placement=self._fold_placement)
+                placement=self._fold_placement,
+                device_fold=self._fold_device)
 
             def fold(dev: DeviceInfo, res) -> None:
                 meta, delta = res

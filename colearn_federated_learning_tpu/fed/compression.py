@@ -86,6 +86,25 @@ def topk_leaf_arrays(node: Any) -> tuple[np.ndarray, np.ndarray, int]:
     return np.asarray(node[_I]), np.asarray(node[_V], np.float32), n
 
 
+def topk_leaf_raw(node: Any) -> tuple[np.ndarray, np.ndarray, np.float32, int]:
+    """Split one topk/topk8 wire leaf into ``(indices, RAW values, scale,
+    size)`` — the device-fold accessor (ops/fold_kernel.py): a topk8 leaf
+    keeps its int8 values UNdecoded so the dequant multiply happens inside
+    the fused fold kernel, in the same ``(value * scale) * weight`` order
+    :func:`topk_leaf_arrays` + the host stage would compute.  A plain topk
+    leaf returns its float32 values with ``scale = 1.0`` (an exact
+    identity multiply for every finite float32)."""
+    if _is_k8leaf(node):
+        n = int(np.asarray(node[_N]).ravel()[0])
+        return (np.asarray(node[_I]), np.asarray(node[_V], np.int8),
+                np.float32(np.asarray(node[_S]).ravel()[0]), n)
+    if not _is_kleaf(node):
+        raise TypeError(f"unexpected node {type(node).__name__} in topk tree")
+    n = int(np.asarray(node[_N]).ravel()[0])
+    return (np.asarray(node[_I]), np.asarray(node[_V], np.float32),
+            np.float32(1.0), n)
+
+
 def compress_delta(
     delta: Any, scheme: str, *, topk_fraction: float | None = None
 ) -> tuple[Any, dict]:

@@ -71,6 +71,13 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
             ]
+            for fold_fn in (lib.cl_fold_sparse_i8, lib.cl_fold_sparse_f32):
+                fold_fn.restype = ctypes.c_int
+                fold_fn.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_float, ctypes.c_float, ctypes.c_int32,
+                ]
             _lib = lib
         except Exception:
             _lib = None
@@ -100,6 +107,35 @@ def topk_abs(flat: np.ndarray, k: int,
     idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
     idx = np.sort(idx).astype(np.int32)
     return idx, flat[idx]
+
+
+def fold_sparse(acc: np.ndarray, idx: np.ndarray, vals: np.ndarray,
+                scale: float, w: float, set_mode: bool) -> bool:
+    """Fused ``acc.reshape(-1)[idx] (=|+=) (vals * scale) * w`` — the
+    ops/fold_kernel.py native lowering (dequant + weight + scatter in one
+    pass, fold.cpp).  ``acc`` must be a writable C-contiguous flat float32
+    array, ``idx`` int64, ``vals`` int8 (topk8 raw) or float32 (topk).
+    Returns False when the native library is unavailable — the caller
+    falls back to the equivalent numpy expression."""
+    lib = load()
+    if lib is None:
+        return False
+    if not (isinstance(acc, np.ndarray) and acc.dtype == np.float32
+            and acc.flags.c_contiguous and acc.flags.writeable):
+        raise ValueError("fold_sparse needs a writable C-contiguous "
+                         "float32 accumulator")
+    idx = np.ascontiguousarray(idx, np.int64)
+    if vals.dtype == np.int8:
+        fn = lib.cl_fold_sparse_i8
+        vals = np.ascontiguousarray(vals)
+    else:
+        fn = lib.cl_fold_sparse_f32
+        vals = np.ascontiguousarray(vals, np.float32)
+    rc = fn(acc.ctypes.data, acc.size, idx.ctypes.data, vals.ctypes.data,
+            idx.size, float(scale), float(w), 1 if set_mode else 0)
+    if rc != 0:
+        raise IndexError("fold_sparse: index out of range")
+    return True
 
 
 def gather_rows(src: np.ndarray, indices: np.ndarray,

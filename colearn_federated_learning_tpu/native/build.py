@@ -13,12 +13,13 @@ import subprocess
 import sys
 
 _ROOT = pathlib.Path(__file__).resolve().parent
-SOURCES = [_ROOT / "src" / "gather.cpp", _ROOT / "src" / "topk.cpp"]
+SOURCES = [_ROOT / "src" / "gather.cpp", _ROOT / "src" / "topk.cpp",
+           _ROOT / "src" / "fold.cpp"]
 # The ABI version is part of the FILENAME: a checkout upgrade can never
 # dlopen a stale cached binary under the new name, and a rebuild after a
 # runtime version mismatch loads from a fresh path (re-dlopening the same
 # path would return the stale handle already held by the process).
-ABI_VERSION = 2  # v2: + cl_topk_abs
+ABI_VERSION = 3  # v3: + cl_fold_sparse_i8 / cl_fold_sparse_f32
 LIB = _ROOT / "_build" / f"libcolearn_native_v{ABI_VERSION}.so"
 
 
@@ -40,8 +41,12 @@ def build(verbose: bool = False) -> pathlib.Path:
                 stale.unlink()
             except OSError:
                 pass
+    # -ffp-contract=off: the fold kernel's (value * scale) * weight pair
+    # must round twice, exactly like the host oracle's two numpy
+    # multiplies — a contracted FMA would change bits and break the
+    # device-vs-host parity pins.
     cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           *map(str, SOURCES), "-o", str(LIB)]
+           "-ffp-contract=off", *map(str, SOURCES), "-o", str(LIB)]
     if verbose:
         print(" ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True, capture_output=not verbose)

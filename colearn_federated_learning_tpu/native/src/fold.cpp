@@ -1,0 +1,75 @@
+// Fused sparse fold: dequant + weight scale + scatter-add into the dense
+// float32 accumulator in ONE pass over the staged (indices, values) pair.
+//
+// This is the wire-speed lowering of ops/fold_kernel.py for a CPU-backend
+// server: one read of the staged sparse contribution, one scattered
+// read-modify-write of the accumulator, no intermediate dense or scaled
+// temporaries.  The multiply ORDER is load-bearing — the host oracle
+// computes (value * scale) first (topk_leaf_arrays' dequant) and applies
+// the aggregation weight second (_stage_topk), two separate float32
+// roundings — so the fused loop does exactly that, and the build pins
+// -ffp-contract=off so the compiler cannot re-associate the pair into an
+// FMA with different bits.
+//
+// SET mode covers the fold's first contribution, which the host path
+// densifies by ASSIGNMENT into fresh zeros (not by adding to them);
+// untouched entries keep the accumulator's exact zero bytes either way.
+//
+// Single-threaded on purpose: within one contribution the top-k indices
+// are unique (threads over disjoint ranges would never collide), but the
+// fold already overlaps the transport threads, and the scatter is
+// memory-bound — the win here is the fusion + the prefetch, not cores.
+
+namespace {
+
+// Prefetch distance tuned on the bench box: far enough to cover the
+// random-access load latency of a ~100 MB accumulator, near enough that
+// the prefetched line is still resident when the write lands.
+constexpr long long kPrefetch = 24;
+
+template <typename V, bool SET>
+inline void fold_loop(float* acc, const long long* idx, const V* vals,
+                      long long k, float scale, float w) {
+    long long j = 0;
+    for (; j + kPrefetch < k; ++j) {
+        __builtin_prefetch(&acc[idx[j + kPrefetch]], 1, 1);
+        const float v = (static_cast<float>(vals[j]) * scale) * w;
+        if (SET) acc[idx[j]] = v; else acc[idx[j]] += v;
+    }
+    for (; j < k; ++j) {
+        const float v = (static_cast<float>(vals[j]) * scale) * w;
+        if (SET) acc[idx[j]] = v; else acc[idx[j]] += v;
+    }
+}
+
+template <typename V>
+int fold_impl(float* acc, long long n, const long long* idx, const V* vals,
+              long long k, float scale, float w, int set_mode) {
+    // Validate before touching acc: a partially applied scatter after a
+    // bad index would leave the accumulator corrupted.
+    for (long long j = 0; j < k; ++j)
+        if (idx[j] < 0 || idx[j] >= n) return 1;
+    if (set_mode) fold_loop<V, true>(acc, idx, vals, k, scale, w);
+    else fold_loop<V, false>(acc, idx, vals, k, scale, w);
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// topk8 frame: int8 values, per-leaf dequant scale.
+int cl_fold_sparse_i8(float* acc, long long n, const long long* idx,
+                      const signed char* vals, long long k,
+                      float scale, float w, int set_mode) {
+    return fold_impl(acc, n, idx, vals, k, scale, w, set_mode);
+}
+
+// topk frame: float32 values (scale rides along as 1.0f).
+int cl_fold_sparse_f32(float* acc, long long n, const long long* idx,
+                       const float* vals, long long k,
+                       float scale, float w, int set_mode) {
+    return fold_impl(acc, n, idx, vals, k, scale, w, set_mode);
+}
+
+}  // extern "C"

@@ -57,6 +57,6 @@ int cl_gather_rows(const uint8_t* src, int64_t n_src_rows, int64_t row_bytes,
 }
 
 // Version marker so a stale cached .so is detected and rebuilt.
-int cl_abi_version() { return 2; }  // v2: + cl_topk_abs (topk.cpp)
+int cl_abi_version() { return 3; }  // v3: + cl_fold_sparse_* (fold.cpp)
 
 }  // extern "C"

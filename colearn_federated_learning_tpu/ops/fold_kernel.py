@@ -1,0 +1,336 @@
+"""Device-resident wire-speed fold: fused, jitted ingest kernels.
+
+The StreamingFolder's hot path (comm/aggregation.py) is a per-update
+host-numpy scatter.  This module moves that fold onto the accelerator:
+one compiled kernel decodes a BATCH of buffered topk/topk8 contributions
+(int8 values dequantized by their per-leaf scale), applies each
+contribution's aggregation weight and scatter-adds the lot into the dense
+accumulator with ``jnp`` ``.at[idx].add(vals)`` — end to end inside one
+XLA computation, eager-free.  Dense and LoRA-factor contributions ride a
+matching batched add kernel.
+
+**Bitwise contract.**  The host fold is the parity oracle, so the kernel
+reproduces its float semantics exactly:
+
+- the batch folds through ``lax.scan`` — ONE compiled dispatch for N
+  buffered contributions, but the accumulation order inside it is the
+  cohort order, add for add, so the result is bit-identical to the host's
+  sequential fold (a segment-sum/psum reorder would not be);
+- the first contribution densifies by ASSIGNMENT (``.at[].set``) into
+  fresh zeros, exactly like the host's ``flat[idx] = vals``;
+- dequant multiplies round in host order: ``(value * scale) * weight``,
+  two float32 roundings (plain topk values carry ``scale = 1.0`` — an
+  exact identity multiply);
+- padding uses ``mode='drop'`` (index == leaf size): a padded entry never
+  touches the accumulator, so bucketing cannot normalize a ``-0.0``;
+  padded DENSE rows are masked with ``jnp.where`` for the same reason.
+
+**Compile-once contract.**  Kernels are cached per model: the module
+cache is keyed on the flattened per-slot shape fingerprint, and batch /
+top-k extents are padded up to power-of-two buckets so jitter in cohort
+size or adaptive-k never retraces.  Every jitted entry point is wrapped
+in a :class:`telemetry.runtime.CompileTracker`, making "compiles once per
+model" a counter the tests pin, not a comment.
+
+**Backends.**  ``xla`` is the device path proper.  On a CPU-only jax
+backend XLA's scatter is slower than numpy, so ``auto`` resolves to the
+``native`` lowering there: the same fused fold (decode + weight + scatter
+in one pass over the staged pairs, ``native/src/fold.cpp``) on the host
+the traffic already lands on — bit-identical to both the host oracle and
+the ``xla`` kernel, and faster than the unfused numpy path.  On real
+accelerators ``auto`` resolves to ``xla``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+BACKENDS = ("auto", "xla", "native")
+
+
+def _bucket(n: int) -> int:
+    """Smallest power of two >= n (>= 1) — the pad target that keeps the
+    jit signature stable under cohort-size / adaptive-k jitter."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def resolve_backend(backend: str = "auto") -> str:
+    """``auto`` → ``native`` on a CPU jax backend with the native library
+    available, else ``xla``.  ``COLEARN_FOLD_BACKEND`` overrides (tests
+    pin each lowering explicitly)."""
+    backend = os.environ.get("COLEARN_FOLD_BACKEND", backend or "auto")
+    if backend not in BACKENDS:
+        raise ValueError(f"unknown fold backend {backend!r}")
+    if backend != "auto":
+        return backend
+    import jax
+
+    from colearn_federated_learning_tpu import native
+
+    if jax.default_backend() == "cpu" and native.load() is not None:
+        return "native"
+    return "xla"
+
+
+class FoldKernel:
+    """Batched fold over a fixed SLOT layout.
+
+    A slot is one accumulator piece: one leaf of the model tree, or one
+    shard of a leaf under a ServerPlacement, always flat float32.  The
+    folder owns the tree<->slot mapping; the kernel only ever sees
+    ``sizes`` (the per-slot element counts) and operates on ``acc`` — a
+    list of flat arrays (device-resident under ``xla``, host numpy under
+    ``native``) that stays resident across calls until :meth:`to_host`.
+
+    ``fold_sparse(acc, batch)``: ``batch`` is a list of
+    ``(weight, slots)`` stages, ``slots`` one ``(idx int64, raw_vals,
+    scale)`` triple per slot (``raw_vals`` int8 for topk8, float32 for
+    topk; one dtype per batch).  ``fold_dense(acc, batch)``: ``batch`` is
+    a list of per-slot lists of flat float32 contributions (pre-scaled at
+    staging, like the host path).  Both accept ``acc=None`` to start a
+    fold with the host's first-contribution semantics.
+    """
+
+    def __init__(self, sizes: Sequence[int], backend: str = "auto"):
+        self.sizes = tuple(int(s) for s in sizes)
+        self.backend = resolve_backend(backend)
+        self.sparse_tracker = None
+        self.dense_tracker = None
+        if self.backend == "xla":
+            self._build_jitted()
+
+    # ------------------------------------------------------- xla path --
+    def _build_jitted(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from colearn_federated_learning_tpu.telemetry.runtime import (
+            CompileTracker,
+        )
+
+        sizes = self.sizes
+
+        def dequant(v, s, ws):
+            # [B, k] raw values x [B] scales x [B] weights -> [B, k] f32
+            # contributions, rounded in host order: (value*scale)*weight,
+            # two float32 roundings.  Computed OUTSIDE the scan so the
+            # whole batch materializes as a while-loop input — the
+            # scatter-add below then reads the product from memory.
+            # (Computing it inside the scan body lets XLA:CPU contract
+            # the weight multiply into the scatter-add as an FMA — one
+            # rounding, 1 ulp off the host oracle; optimization_barrier
+            # and bitcast round-trips are both stripped before codegen.)
+            return (v.astype(jnp.float32) * s[:, None]) * ws[:, None]
+
+        def sparse_step(accs, xs):
+            xi, xc = xs
+            return tuple(
+                a.at[i].add(c, mode="drop")
+                for a, i, c in zip(accs, xi, xc)
+            ), None
+
+        def sparse_into(accs, idxs, valss, scales, ws):
+            contribs = tuple(
+                dequant(v, s, ws) for v, s in zip(valss, scales))
+            accs, _ = jax.lax.scan(
+                sparse_step, tuple(accs), (idxs, contribs))
+            return accs
+
+        def sparse_init(idxs, valss, scales, ws):
+            contribs = tuple(
+                dequant(v, s, ws) for v, s in zip(valss, scales))
+            # Row 0 is always a real contribution (the wrapper only calls
+            # the init variant with a non-empty batch): assignment into
+            # fresh zeros, exactly the host's first densify.
+            accs = tuple(
+                jnp.zeros(n, jnp.float32).at[i[0]].set(c[0], mode="drop")
+                for n, i, c in zip(sizes, idxs, contribs)
+            )
+            rest = jax.tree.map(lambda x: x[1:], (idxs, contribs))
+            accs, _ = jax.lax.scan(sparse_step, accs, rest)
+            return accs
+
+        def dense_step(accs, xs):
+            x, ok = xs
+            # where, not +0.0: a padded row must leave the accumulator's
+            # exact bits (adding zero would normalize a -0.0 entry).
+            return tuple(
+                jnp.where(ok, a + xi, a) for a, xi in zip(accs, x)
+            ), None
+
+        def dense_into(accs, xss, valid):
+            accs, _ = jax.lax.scan(dense_step, tuple(accs), (xss, valid))
+            return accs
+
+        def dense_init(xss, valid):
+            # The host path ADOPTS the first contribution as the
+            # accumulator; row 0 is always real here too.
+            accs = tuple(x[0] for x in xss)
+            rest = jax.tree.map(lambda x: x[1:], (xss, valid))
+            accs, _ = jax.lax.scan(dense_step, accs, rest)
+            return accs
+
+        self._sparse_into = CompileTracker(
+            jax.jit(sparse_into), name="fold_kernel.sparse_into")
+        self._sparse_init = CompileTracker(
+            jax.jit(sparse_init), name="fold_kernel.sparse_init")
+        self._dense_into = CompileTracker(
+            jax.jit(dense_into), name="fold_kernel.dense_into")
+        self._dense_init = CompileTracker(
+            jax.jit(dense_init), name="fold_kernel.dense_init")
+        self.sparse_tracker = self._sparse_into
+        self.dense_tracker = self._dense_into
+
+    @property
+    def compiles(self) -> int:
+        """Total first-signature compiles across the jitted entry points
+        (0 under the native lowering — nothing traces)."""
+        if self.backend != "xla":
+            return 0
+        return sum(t.compiles for t in (
+            self._sparse_into, self._sparse_init,
+            self._dense_into, self._dense_init))
+
+    @property
+    def recompiles(self) -> int:
+        if self.backend != "xla":
+            return 0
+        return sum(t.recompiles for t in (
+            self._sparse_into, self._sparse_init,
+            self._dense_into, self._dense_init))
+
+    # ---------------------------------------------------- sparse fold --
+    def _pad_sparse(self, batch: Sequence) -> tuple:
+        """Pad/stack one sparse batch to bucketed extents.
+
+        Batch rows pad with weight 0 and index == slot size; per-slot k
+        pads likewise — every padded entry carries an out-of-range index,
+        so ``mode='drop'`` guarantees it never touches the accumulator
+        regardless of its (zero) value.
+        """
+        b = len(batch)
+        bb = _bucket(b)
+        vdt = batch[0][1][0][1].dtype
+        idxs, valss, scales = [], [], []
+        for s, n in enumerate(self.sizes):
+            kb = _bucket(max(int(stage[1][s][0].size) for stage in batch))
+            idx = np.full((bb, kb), n, np.int64)
+            vals = np.zeros((bb, kb), vdt)
+            sc = np.ones(bb, np.float32)
+            for r, (_, slots) in enumerate(batch):
+                si, sv, ss = slots[s]
+                idx[r, :si.size] = si
+                vals[r, :sv.size] = sv
+                sc[r] = ss
+            idxs.append(idx)
+            valss.append(vals)
+            scales.append(sc)
+        ws = np.zeros(bb, np.float32)
+        ws[:b] = [w for w, _ in batch]
+        return tuple(idxs), tuple(valss), tuple(scales), ws
+
+    def fold_sparse(self, acc: Optional[list], batch: Sequence) -> list:
+        if not batch:
+            return acc
+        if self.backend == "native":
+            return self._fold_sparse_native(acc, batch)
+        idxs, valss, scales, ws = self._pad_sparse(batch)
+        if acc is None:
+            return list(self._sparse_init(idxs, valss, scales, ws))
+        return list(self._sparse_into(tuple(acc), idxs, valss, scales, ws))
+
+    def _fold_sparse_native(self, acc: Optional[list], batch) -> list:
+        from colearn_federated_learning_tpu import native
+
+        init = acc is None
+        if init:
+            acc = [np.zeros(n, np.float32) for n in self.sizes]
+        for w, slots in batch:
+            for a, (idx, vals, scale) in zip(acc, slots):
+                if not native.fold_sparse(a, idx, vals, scale, w, init):
+                    # No toolchain: the equivalent numpy expression —
+                    # same multiply order, same set-then-add semantics.
+                    v = (vals.astype(np.float32) * scale) * np.float32(w)
+                    if init:
+                        a[idx] = v
+                    else:
+                        a[idx] += v
+            init = False
+        return acc
+
+    # ----------------------------------------------------- dense fold --
+    def fold_dense(self, acc: Optional[list], batch: Sequence) -> list:
+        if not batch:
+            return acc
+        if self.backend == "native":
+            # Host-speed lowering: adopt-then-add, identical to the host
+            # fold (numpy IS the wire-speed dense add on a CPU server).
+            start = 0
+            if acc is None:
+                acc = list(batch[0])
+                start = 1
+            for slots in batch[start:]:
+                for a, x in zip(acc, slots):
+                    np.add(a, x, out=a)
+            return acc
+        bb = _bucket(len(batch))
+        valid = np.zeros(bb, bool)
+        valid[:len(batch)] = True
+        xss = []
+        for s, n in enumerate(self.sizes):
+            x = np.zeros((bb, n), np.float32)
+            for r, slots in enumerate(batch):
+                x[r] = slots[s]
+            xss.append(x)
+        if acc is None:
+            return list(self._dense_init(tuple(xss), valid))
+        return list(self._dense_into(tuple(acc), tuple(xss), valid))
+
+    # ------------------------------------------------------- delivery --
+    def to_host(self, acc: Optional[list]) -> Optional[list]:
+        """Accumulator slots as host numpy (ONE device→host transfer per
+        fold block under ``xla``; a no-op under ``native``)."""
+        if acc is None:
+            return None
+        return [a if isinstance(a, np.ndarray) else np.asarray(a)
+                for a in acc]
+
+
+_KERNELS: dict[tuple, FoldKernel] = {}
+_KERNELS_LOCK = threading.Lock()
+
+
+def get_kernel(sizes: Sequence[int], backend: str = "auto") -> FoldKernel:
+    """The shared kernel for one model's slot layout — cached on the
+    shape fingerprint so every folder of the same model (one per round on
+    the coordinator) reuses the same jitted computations: the kernel
+    compiles once per model, not once per round."""
+    resolved = resolve_backend(backend)
+    key = (tuple(int(s) for s in sizes), resolved)
+    with _KERNELS_LOCK:
+        k = _KERNELS.get(key)
+        if k is None:
+            k = _KERNELS[key] = FoldKernel(key[0], backend=resolved)
+        return k
+
+
+def clear_kernel_cache() -> None:
+    """Drop cached kernels (tests that count compiles from scratch)."""
+    with _KERNELS_LOCK:
+        _KERNELS.clear()
+
+
+__all__ = [
+    "BACKENDS",
+    "FoldKernel",
+    "clear_kernel_cache",
+    "get_kernel",
+    "resolve_backend",
+]

@@ -354,6 +354,12 @@ class RunConfig:
     # aggregator auto-sizes its fold threshold K so one partial ships
     # upstream about this often at the slice's observed arrival rate.
     agg_buffer_interval_s: float = 2.0
+    # Device-resident fold (--fold-device, ops/fold_kernel.py): server
+    # folds run through the fused batched kernel — in-kernel topk8
+    # dequant + weighting + scatter, one compile per model — instead of
+    # the per-update host-numpy scatter.  The host path stays the
+    # bitwise parity oracle; False keeps it byte-identical to before.
+    fold_device: bool = False
     # Per-device health ledger (telemetry/health.py): directory the
     # coordinator/aggregator/fleetsim planes write durable straggler
     # attribution into.  None = plane off, no extra I/O, and round

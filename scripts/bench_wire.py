@@ -21,7 +21,21 @@ the bench CNN shape and measures, per round:
   (``agnews_bert_fedavg``, BERT-base) via shape-only frame math — no
   110M-param alloc — plus one real 2-worker factor-uplink federation at
   a tiny BERT shape to prove the plane end to end (serialize-once
-  broadcast, factor fold, periodic server merge).
+  broadcast, factor fold, periodic server merge);
+- the FOLD sweep (``--fold-frames`` × host/device × batch 1/cohort):
+  server-ingest throughput (updates/s) at real BERT-base shapes through
+  ``StreamingFolder`` — the host oracle vs the fused device kernel
+  (``ops/fold_kernel.py``), one ``wire_fold`` row per path with measured
+  bitwise parity against the host accumulator; the run FAILS if any
+  device row breaks parity or the batched topk8 device fold is slower
+  than the host.
+
+With ``--fold-device`` the wire rounds themselves ingest through the
+device kernel (``fold_device_folds_per_round`` must equal the cohort or
+the run fails).  ``--check-schema`` validates every emitted row against
+the published row schemas after the run; ``--check-only`` just validates
+an existing ``--out`` file and exits (the CI gate over committed
+results).
 
 One JSON summary line per configuration is written to
 ``results/wire_bench.jsonl`` (PERF.md "Wire plane" and the SLO sentinel
@@ -33,6 +47,10 @@ Usage (CPU):
         --cohorts 2,4 --schemes none,topk --feedback off,on --rounds 5
     JAX_PLATFORMS=cpu python scripts/bench_wire.py \\
         --lora-ranks 4 --lora-only --rounds 3   # CI lora-smoke shape
+    JAX_PLATFORMS=cpu python scripts/bench_wire.py \\
+        --fold-only --fold-frames topk8 --fold-repeats 2  # §7k fold rows
+    python scripts/bench_wire.py --check-only \\
+        --out results/wire_bench.jsonl           # schema-gate committed rows
 """
 
 from __future__ import annotations
@@ -72,14 +90,88 @@ _COUNTERS = (
     "comm.bytes_saved_downlink",
     "comm.bytes_saved_uplink",
     "comm.uplink_densify_avoided_total",
+    "comm.fold_device_total",
     "comm.resync_total",
     "comm.gather_bytes_avoided_total",
 )
 
+# Schema contract for every row this bench writes; --check-schema (CI)
+# validates the output (or the committed results via --check-only)
+# against these, so a field rename can never silently blind the PERF.md
+# tables or the SLO sentinel rules that read the JSONL.
+ROW_SCHEMA = {
+    "bench": str,
+    "model": str,
+    "dataset": str,
+    "cohort": int,
+    "scheme_down": str,
+    "scheme_up": str,
+    "feedback": bool,
+    "tp_size": int,
+    "fold_device": bool,
+    "fold_device_folds_per_round": int,
+    "rounds": int,
+    "encodes_per_round": int,
+    "full_frame_bytes": int,
+    "downlink_frame_bytes": int,
+    "downlink_reduction_x": float,
+    "uplink_frame_bytes": int,
+    "uplink_dense_bytes": int,
+    "uplink_bytes_ratio": float,
+    "uplink_reduction_x": float,
+    "round_time_s_mean": float,
+    "bench_wall_s": float,
+}
+
+LORA_ROW_SCHEMA = {
+    "bench": str,
+    "model": str,
+    "cohort": int,
+    "rounds": int,
+    "lora_rank": int,
+    "dense_params": int,
+    "factor_params": int,
+    "encodes_per_round": int,
+    "uplink_frame_bytes": int,
+    "uplink_dense_bytes": int,
+    "uplink_bytes_ratio": float,
+    "uplink_reduction_x": float,
+    "lora_merges": int,
+    "round_time_s_mean": float,
+    "bench_wall_s": float,
+}
+
+# Fold-throughput rows (--fold-frames): updates/s folded through the
+# StreamingFolder at BERT-base, host oracle vs device kernel, batch 1
+# vs K — what the wire-fold-* sentinel rules gate.
+FOLD_ROW_SCHEMA = {
+    "bench": str,
+    "model": str,
+    "frame": str,
+    "path": str,
+    "batch": int,
+    "cohort": int,
+    "repeats": int,
+    "param_count": int,
+    "staged_values": int,
+    "kernel_backend": str,
+    "updates_per_s": float,
+    "fold_wall_s": float,
+    "speedup_vs_host": float,
+    "parity_bitwise": bool,
+    "bench_wall_s": float,
+}
+
+SCHEMAS = {
+    "wire_round": ROW_SCHEMA,
+    "wire_lora": LORA_ROW_SCHEMA,
+    "wire_fold": FOLD_ROW_SCHEMA,
+}
+
 
 def bench_config(n_workers: int, scheme_down: str, tp_size: int = 1,
-                 scheme_up: str = "none",
-                 feedback: bool = False) -> ExperimentConfig:
+                 scheme_up: str = "none", feedback: bool = False,
+                 fold_device: bool = False) -> ExperimentConfig:
     """The bench CNN shape: a width-16 conv net on mnist_tiny — big enough
     (~100 kB of float32 params) that frame encode/copy costs are visible,
     small enough to compile and train in seconds on CPU."""
@@ -92,13 +184,14 @@ def bench_config(n_workers: int, scheme_down: str, tp_size: int = 1,
                       compress=scheme_up, compress_feedback=feedback,
                       compress_down=scheme_down),
         run=RunConfig(name="bench_wire", backend="cpu", seed=0,
-                      tp_size=tp_size),
+                      tp_size=tp_size, fold_device=fold_device),
     )
 
 
 def run_bench(n_workers: int, scheme_down: str, scheme_up: str,
               feedback: bool, tp_size: int, rounds: int,
-              warmup_timeout: float, round_timeout: float) -> dict:
+              warmup_timeout: float, round_timeout: float,
+              fold_device: bool = False) -> dict:
     from colearn_federated_learning_tpu.comm.broker import MessageBroker
     from colearn_federated_learning_tpu.comm.coordinator import (
         FederatedCoordinator,
@@ -112,7 +205,8 @@ def run_bench(n_workers: int, scheme_down: str, scheme_up: str,
     import numpy as np
 
     config = bench_config(n_workers, scheme_down, tp_size,
-                          scheme_up=scheme_up, feedback=feedback)
+                          scheme_up=scheme_up, feedback=feedback,
+                          fold_device=fold_device)
     reg = telemetry.get_registry()
 
     broker = MessageBroker().start()
@@ -170,6 +264,7 @@ def run_bench(n_workers: int, scheme_down: str, scheme_up: str,
                     delta["comm.bytes_saved_uplink"]),
                 "densify_avoided": int(
                     delta["comm.uplink_densify_avoided_total"]),
+                "fold_device_folds": int(delta["comm.fold_device_total"]),
                 "resyncs": int(delta["comm.resync_total"]),
                 "gather_avoided": int(
                     delta["comm.gather_bytes_avoided_total"]),
@@ -199,6 +294,11 @@ def run_bench(n_workers: int, scheme_down: str, scheme_up: str,
         "scheme_up": scheme_up,
         "feedback": feedback,
         "tp_size": tp_size,
+        # Device-resident fold (--fold-device): contributions folded
+        # through the fused kernel per round (0 on the host path).
+        "fold_device": fold_device,
+        "fold_device_folds_per_round": int(min(
+            r["fold_device_folds"] for r in per_round)),
         "rounds": rounds,
         # Sharded server (tp_size > 1): per-chip server-state bytes and
         # the per-round gather bytes the shard-wise downlink never moved.
@@ -392,6 +492,172 @@ def run_lora_bench(rank: int, rounds: int, warmup_timeout: float,
     }
 
 
+def run_fold_rows(frame: str, cohort: int, repeats: int,
+                  topk_fraction: float = 0.01) -> list[dict]:
+    """Fold-throughput rows at BERT-base: updates/s folded through the
+    StreamingFolder for one frame type — the host fold (the parity
+    oracle) vs the device kernel (ops/fold_kernel.py, backend resolved
+    by ``auto``: the fused native lowering on a CPU host, the jitted
+    XLA scan on an accelerator), device at batch=1 vs batch=cohort.
+    Frame generation and staging reuse ONE synthetic wire tree per
+    frame; only the fold is timed.  Every device row carries a measured
+    ``parity_bitwise`` bit against the host fold of the same cohort."""
+    from colearn_federated_learning_tpu.comm.aggregation import (
+        StreamingFolder,
+    )
+    from colearn_federated_learning_tpu.fed import compression
+    from colearn_federated_learning_tpu.fed import lora as lora_lib
+    from colearn_federated_learning_tpu.models import registry as models
+    from colearn_federated_learning_tpu.ops import fold_kernel
+    from colearn_federated_learning_tpu.utils.config import get_config
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.time()
+    bert_cfg = get_config("agnews_bert_fedavg").model
+    model = models.build_model(bert_cfg)
+    shape_tree = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, bert_cfg.seq_len), jnp.int32),
+                             train=False),
+        jax.random.PRNGKey(0))["params"]
+    params_view = jax.tree.map(
+        lambda l: np.broadcast_to(np.zeros((), np.dtype(l.dtype)), l.shape),
+        shape_tree)
+
+    rng = np.random.default_rng(19)
+
+    def rand_tree(view):
+        return jax.tree.map(
+            lambda l: rng.standard_normal(l.shape, dtype=np.float32),
+            view)
+
+    if frame == "dense":
+        fold_shapes = params_view
+        wire, cmeta = rand_tree(params_view), {"compress": "none"}
+    elif frame == "topk8":
+        fold_shapes = params_view
+        wire, cmeta = compression.compress_delta(
+            rand_tree(params_view), "topk8", topk_fraction=topk_fraction)
+    elif frame.startswith("lora_r"):
+        rank = int(frame[len("lora_r"):])
+        factors_view = lora_lib.init_factors(params_view, rank,
+                                             model_name=bert_cfg.name)
+        fold_shapes = jax.tree.map(
+            lambda l: np.broadcast_to(np.zeros((), np.float32), l.shape),
+            factors_view)
+        wire, cmeta = rand_tree(fold_shapes), {"compress": "none"}
+    else:
+        raise SystemExit(f"unknown fold frame {frame!r}")
+
+    param_count = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(params_view))
+    staged_values = (
+        sum(int(np.asarray(n["v"]).size)
+            for n in jax.tree.leaves(
+                wire, is_leaf=lambda x: isinstance(x, dict) and "v" in x))
+        if frame == "topk8"
+        else sum(int(np.prod(l.shape)) for l in jax.tree.leaves(wire)))
+    updates = [({"client_id": str(i), "weight": 1.0 + 0.25 * i,
+                 "mean_loss": 0.5, **cmeta}, wire)
+               for i in range(cohort)]
+
+    def fold_once(device, batch_max):
+        f = StreamingFolder(fold_shapes,
+                            order=[m["client_id"] for m, _ in updates],
+                            device_fold=device)
+        f._fold_batch_max = batch_max
+        for meta, w in updates:
+            f.add(dict(meta), w)
+        f.finalize()
+        return f
+
+    def timed(device, batch_max):
+        fold_once(device, batch_max)        # warmup: jit/kernel/lib caches
+        t = time.perf_counter()
+        for _ in range(repeats):
+            folder = fold_once(device, batch_max)
+        wall = time.perf_counter() - t
+        return folder, wall
+
+    host_folder, host_wall = timed(False, None)
+    host_bytes = [np.asarray(l).tobytes()
+                  for l in jax.tree.leaves(host_folder.wsum)]
+    host_ups = cohort * repeats / host_wall
+    backend = fold_kernel.resolve_backend()
+
+    def row(path, batch, folder, wall):
+        ups = cohort * repeats / wall
+        parity = ([np.asarray(l).tobytes()
+                   for l in jax.tree.leaves(folder.wsum)] == host_bytes)
+        return {
+            "bench": "wire_fold",
+            "model": "bert-base",
+            "frame": frame,
+            "path": path,
+            "batch": batch,
+            "cohort": cohort,
+            "repeats": repeats,
+            "param_count": param_count,
+            "staged_values": int(staged_values),
+            "kernel_backend": backend if path == "device" else "host",
+            "updates_per_s": round(ups, 2),
+            "fold_wall_s": round(wall, 4),
+            "speedup_vs_host": round(ups / host_ups, 3),
+            "parity_bitwise": bool(parity),
+            "bench_wall_s": round(time.time() - t0, 1),
+        }
+
+    rows = [row("host", 1, host_folder, host_wall)]
+    for batch in (1, cohort):
+        folder, wall = timed(True, batch if batch > 1 else 1)
+        rows.append(row("device", batch, folder, wall))
+    return rows
+
+
+def check_schema(path: str) -> int:
+    """Validate every row of the bench JSONL against the schema for its
+    ``bench`` tag (CI gate): required fields present, numerics numeric."""
+    bad = 0
+    try:
+        with open(path) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+    except OSError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    if not rows:
+        print(f"FAIL: {path} is empty", file=sys.stderr)
+        return 1
+    for i, row in enumerate(rows):
+        schema = SCHEMAS.get(row.get("bench"))
+        if schema is None:
+            print(f"FAIL: row {i} unknown bench {row.get('bench')!r}",
+                  file=sys.stderr)
+            bad += 1
+            continue
+        for key, typ in schema.items():
+            if key not in row:
+                print(f"FAIL: row {i} ({row['bench']}) missing {key!r}",
+                      file=sys.stderr)
+                bad += 1
+            elif typ is float and not isinstance(row[key], (int, float)):
+                print(f"FAIL: row {i} {key!r} not numeric", file=sys.stderr)
+                bad += 1
+            elif typ is not float and not isinstance(row[key], typ):
+                print(f"FAIL: row {i} {key!r} not {typ.__name__}",
+                      file=sys.stderr)
+                bad += 1
+        if (row.get("bench") == "wire_fold" and row.get("path") == "device"
+                and row.get("parity_bitwise") is not True):
+            print(f"FAIL: row {i} device fold row without bitwise parity",
+                  file=sys.stderr)
+            bad += 1
+    if not bad:
+        print(f"schema ok: {len(rows)} row(s) in {path}")
+    return 1 if bad else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rounds", type=int, default=5,
@@ -418,12 +684,37 @@ def main(argv=None) -> int:
                          "string skips the sweep")
     ap.add_argument("--lora-only", action="store_true",
                     help="run only the --lora-ranks sweep (CI lora-smoke)")
+    ap.add_argument("--fold-device", action="store_true",
+                    help="run the e2e federation rows with the device-"
+                         "resident fold (RunConfig.fold_device; the CI "
+                         "wire-smoke proves one real round through it)")
+    ap.add_argument("--fold-frames", default="dense,topk8,lora_r4",
+                    help="comma-separated frame types for the fold-"
+                         "throughput sweep at BERT-base (host vs device, "
+                         "batch 1 vs K); empty string skips the sweep")
+    ap.add_argument("--fold-cohort", type=int, default=4,
+                    help="contributions per fold (the K in batch 1 vs K)")
+    ap.add_argument("--fold-repeats", type=int, default=3,
+                    help="timed folds per fold-throughput row")
+    ap.add_argument("--fold-only", action="store_true",
+                    help="run only the --fold-frames sweep (CI wire-smoke)")
+    ap.add_argument("--check-schema", action="store_true",
+                    help="after the sweep, validate the output JSONL "
+                         "against the per-bench row schemas and fail on "
+                         "any mismatch")
+    ap.add_argument("--check-only", action="store_true",
+                    help="validate the existing --out JSONL against the "
+                         "row schemas and exit (no benches run) — the CI "
+                         "gate over the committed results")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "results", "wire_bench.jsonl"))
     ap.add_argument("--warmup-timeout", type=float, default=300.0)
     ap.add_argument("--round-timeout", type=float, default=60.0)
     args = ap.parse_args(argv)
+
+    if args.check_only:
+        return check_schema(args.out)
 
     tp_sizes = [int(t) for t in args.tp_sizes.split(",") if t]
     cohorts = [int(c) for c in args.cohorts.split(",") if c]
@@ -432,7 +723,8 @@ def main(argv=None) -> int:
     def bench_row(n, scheme_down, scheme_up, fb, tp):
         t0 = time.time()
         row = run_bench(n, scheme_down, scheme_up, fb, tp, args.rounds,
-                        args.warmup_timeout, args.round_timeout)
+                        args.warmup_timeout, args.round_timeout,
+                        fold_device=args.fold_device)
         row["bench_wall_s"] = round(time.time() - t0, 1)
         rows.append(row)
         print(json.dumps({k: v for k, v in row.items()
@@ -441,6 +733,11 @@ def main(argv=None) -> int:
             raise SystemExit(
                 f"FAIL: {row['encodes_per_round']} broadcast encodes per "
                 f"round at cohort {n} (want exactly 1)")
+        if args.fold_device and row["fold_device_folds_per_round"] < n:
+            raise SystemExit(
+                f"FAIL: --fold-device round folded "
+                f"{row['fold_device_folds_per_round']} of {n} "
+                "contributions through the device kernel")
         if tp > 1 and row["gather_bytes_avoided_per_round"] <= 0:
             raise SystemExit(
                 f"FAIL: tp_size={tp} row avoided no gather bytes "
@@ -489,6 +786,33 @@ def main(argv=None) -> int:
                 "the base model (lora_merge_every not engaged)")
         return row
 
+    def fold_rows(frame):
+        for row in run_fold_rows(frame, args.fold_cohort,
+                                 args.fold_repeats):
+            rows.append(row)
+            print(json.dumps(row))
+            if row["path"] == "device" and not row["parity_bitwise"]:
+                raise SystemExit(
+                    f"FAIL: device fold of {frame} frames diverged from "
+                    "the host oracle (bitwise parity broken)")
+            if (row["frame"] == "topk8" and row["path"] == "device"
+                    and row["batch"] > 1
+                    and row["speedup_vs_host"] < 1.0):
+                raise SystemExit(
+                    f"FAIL: batched device fold of topk8 frames is "
+                    f"SLOWER than the host fold "
+                    f"({row['speedup_vs_host']}x)")
+
+    if args.fold_only:
+        for frame in (s.strip() for s in args.fold_frames.split(",") if s):
+            fold_rows(frame)
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"wrote {len(rows)} rows to {args.out}")
+        return check_schema(args.out) if args.check_schema else 0
+
     if not args.lora_only:
         # Downlink matrix (unchanged axes): cohorts × down-schemes × tp.
         for n in cohorts:
@@ -514,12 +838,17 @@ def main(argv=None) -> int:
     for rank_s in (s.strip() for s in args.lora_ranks.split(",") if s):
         lora_row(int(rank_s))
 
+    # Fold-throughput sweep at BERT-base: host vs device, batch 1 vs K.
+    if not args.lora_only:
+        for frame in (s.strip() for s in args.fold_frames.split(",") if s):
+            fold_rows(frame)
+
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         for row in rows:
             f.write(json.dumps(row) + "\n")
     print(f"wrote {len(rows)} rows to {args.out}")
-    return 0
+    return check_schema(args.out) if args.check_schema else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,277 @@
+"""PR 19 device fold (ops/fold_kernel.py): the fused batched kernel vs
+the host StreamingFolder, which stays the bitwise parity oracle.
+
+- Bitwise parity across every frame type the folder stages — dense
+  ("none"), int8, topk, topk8, LoRA-factor trees — under BOTH kernel
+  lowerings (``native`` fused C++ and the ``xla`` jitted scan), full and
+  partial cohorts, pre-folded partials, the secure-agg correction hook,
+  and the tp=2 sharded server.
+- Batched-vs-sequential equivalence: folding a block through one
+  batched dispatch equals folding it one contribution at a time
+  (``lax.scan`` keeps cohort order, add for add).
+- Compile-once-per-model: the kernel cache is keyed on the slot-shape
+  fingerprint and batch/k extents bucket to powers of two, so a second
+  folder of the same model re-uses the compiled kernels — pinned via
+  the CompileTracker counters, not asserted prose.
+- Staging-time ownership: read-only partial inputs are copied at most
+  once, at staging; caller arrays are never mutated by the fold.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from colearn_federated_learning_tpu.comm.aggregation import StreamingFolder
+from colearn_federated_learning_tpu.fed import compression
+from colearn_federated_learning_tpu.ops import fold_kernel
+from colearn_federated_learning_tpu.parallel import partition
+
+from tests.test_uplink_fastpath import _params, _tree_bytes
+
+BACKENDS = ["native", "xla"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_kernel_cache():
+    fold_kernel.clear_kernel_cache()
+    yield
+    fold_kernel.clear_kernel_cache()
+
+
+def _updates(scheme, n=5, shapes=None, fraction=0.1, seed=300):
+    shapes = _params() if shapes is None else shapes
+    out = []
+    for i in range(n):
+        rng = np.random.default_rng(seed + i)
+        d = jax.tree.map(
+            lambda w: rng.standard_normal(w.shape).astype(np.float32),
+            shapes)
+        wire, cmeta = compression.compress_delta(
+            d, scheme, topk_fraction=fraction)
+        meta = {"client_id": str(i), "weight": 1.0 + 0.25 * i,
+                "mean_loss": 0.5 + 0.1 * i, **cmeta}
+        out.append((meta, wire))
+    return out
+
+
+def _run_fold(shapes, updates, *, device=False, backend="native",
+              placement=None, batch_max=None, partials=(), correction=None,
+              order=None):
+    """Build, feed, and finalize one folder; ``backend`` pins the kernel
+    lowering via the env override while the fold runs."""
+    if order is None:
+        order = [m["client_id"] for m, _ in updates]
+        order += [key for key, *_ in partials]
+    prev = os.environ.get("COLEARN_FOLD_BACKEND")
+    os.environ["COLEARN_FOLD_BACKEND"] = backend
+    try:
+        f = StreamingFolder(shapes, order=order, placement=placement,
+                            device_fold=device)
+        if batch_max is not None:
+            f._fold_batch_max = batch_max
+        for meta, wire in updates:
+            f.add(dict(meta), jax.tree.map(np.copy, wire))
+        for key, tw, tree, ls in partials:
+            f.add_partial(key, tw, tree, ls)
+        f.finalize()
+        if correction is not None:
+            f.apply_correction(correction)
+        return f
+    finally:
+        if prev is None:
+            os.environ.pop("COLEARN_FOLD_BACKEND", None)
+        else:
+            os.environ["COLEARN_FOLD_BACKEND"] = prev
+
+
+def _assert_folds_equal(host, dev):
+    assert dev.total_w == host.total_w
+    assert dev.loss_sum == host.loss_sum
+    assert _tree_bytes(dev.wsum) == _tree_bytes(host.wsum)
+
+
+# ------------------------------------------------------- frame parity ----
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("scheme", ["none", "int8", "topk", "topk8"])
+def test_device_fold_bitwise_parity(scheme, backend):
+    shapes = _params()
+    updates = _updates(scheme)
+    host = _run_fold(shapes, updates)
+    dev = _run_fold(shapes, updates, device=True, backend=backend)
+    _assert_folds_equal(host, dev)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_lora_factor_frames_parity(backend):
+    # Factor trees fold dense (per-leaf scaled numpy) with the rank-wide
+    # leaves LoRA ships; the device fold must reproduce them bit for bit.
+    shapes = {
+        "TransformerBlock_0/attn/query/kernel":
+            {"a": np.zeros((4, 16), np.float32),
+             "b": np.zeros((16, 4), np.float32)},
+        "TransformerBlock_0/Dense_0/kernel":
+            {"a": np.zeros((4, 32), np.float32),
+             "b": np.zeros((8, 4), np.float32)},
+    }
+    updates = _updates("none", shapes=shapes, seed=500)
+    host = _run_fold(shapes, updates)
+    dev = _run_fold(shapes, updates, device=True, backend=backend)
+    _assert_folds_equal(host, dev)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("scheme", ["none", "topk8"])
+def test_tp2_sharded_parity(scheme, backend):
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs the forced 8-device CPU host")
+    pl = partition.make_server_placement(
+        _params(), 2, "model", "bert", devices=devs[:2])
+    assert pl is not None
+    shapes = pl.shapes_tree()
+    updates = _updates(scheme, shapes=_params(), seed=700)
+    host = _run_fold(shapes, updates, placement=pl)
+    dev = _run_fold(shapes, updates, device=True, backend=backend,
+                    placement=pl)
+    _assert_folds_equal(host, dev)
+    # The assembled sharded means agree too (same shard bytes).
+    m_host = partition.host_tree(host.mean()[0])
+    m_dev = partition.host_tree(dev.mean()[0])
+    assert _tree_bytes(m_host) == _tree_bytes(m_dev)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_partial_cohort_parity(backend):
+    shapes = _params()
+    order = [str(i) for i in range(5)]
+    updates = _updates("topk8")[:3]          # two cohort slots never reply
+    host = _run_fold(shapes, updates, order=order)
+    dev = _run_fold(shapes, updates, order=order, device=True,
+                    backend=backend)
+    assert dev.count == host.count == 3
+    _assert_folds_equal(host, dev)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_partials_and_correction_parity(backend):
+    shapes = _params()
+    updates = _updates("topk", seed=900)
+    direct, sliced = updates[:3], updates[3:]
+
+    def partial():
+        sub = StreamingFolder(shapes,
+                              order=[m["client_id"] for m, _ in sliced])
+        for meta, wire in sliced:
+            sub.add(dict(meta), jax.tree.map(np.copy, wire))
+        sub.finalize()
+        return [("agg:0", sub.total_w, sub.wsum, sub.loss_sum)]
+
+    rng = np.random.default_rng(17)
+    correction = jax.tree.map(
+        lambda w: (rng.standard_normal(w.shape) * 1e-3).astype(np.float32),
+        shapes)
+    host = _run_fold(shapes, direct, partials=partial(),
+                     correction=correction)
+    dev = _run_fold(shapes, direct, device=True, backend=backend,
+                    partials=partial(), correction=correction)
+    _assert_folds_equal(host, dev)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_batched_vs_sequential_fold_equivalence(backend):
+    # Mixed cohort: topk8 / topk (a value-dtype run boundary) / dense,
+    # interleaved — one batched dispatch per run vs one contribution at
+    # a time must produce identical bits (scan keeps cohort order).
+    shapes = _params()
+    mixed = []
+    for i, scheme in enumerate(["topk8", "topk8", "topk", "none", "topk8"]):
+        meta, wire = _updates(scheme, n=1, seed=1100 + i)[0]
+        meta["client_id"] = str(i)
+        mixed.append((meta, wire))
+    host = _run_fold(shapes, mixed)
+    batched = _run_fold(shapes, mixed, device=True, backend=backend)
+    seq = _run_fold(shapes, mixed, device=True, backend=backend,
+                    batch_max=1)
+    _assert_folds_equal(host, batched)
+    _assert_folds_equal(host, seq)
+
+
+def test_negative_zero_bits_survive_padding():
+    # A staged -0.0 lands in the accumulator by first-densify assignment;
+    # bucketing pads rows/k with out-of-range indices (mode='drop'), so
+    # no padded add may normalize it to +0.0.  Three updates bucket to
+    # B=4 (one padded row); only update 0 touches index 3.
+    shapes = {"w": np.zeros((8,), np.float32)}
+    upd = []
+    for i, (idx, val) in enumerate([(3, -0.0), (1, 1.5), (6, -2.0)]):
+        wire = {"w": {"i": np.array([idx], np.int64),
+                      "v": np.array([val], np.float32),
+                      "n": np.array([8], np.int64)}}
+        upd.append(({"client_id": str(i), "weight": 1.0, "mean_loss": 0.0,
+                     "compress": "topk"}, wire))
+    for backend in BACKENDS:
+        fold_kernel.clear_kernel_cache()
+        dev = _run_fold(shapes, upd, device=True, backend=backend)
+        out = np.asarray(dev.wsum["w"])
+        assert out[3] == 0.0 and np.signbit(out[3]), backend
+    host = _run_fold(shapes, upd)
+    assert _tree_bytes(host.wsum) == _tree_bytes(dev.wsum)
+
+
+# ------------------------------------------------- compile-once pinning ----
+def test_one_compile_per_model_via_tracker():
+    shapes = _params()
+    updates = _updates("topk8", seed=1300)
+
+    dev1 = _run_fold(shapes, updates, device=True, backend="xla")
+    kernel = dev1._kernel
+    assert kernel is not None and kernel.backend == "xla"
+    compiles_after_first = kernel.compiles
+    assert compiles_after_first > 0
+    assert kernel.recompiles == 0
+
+    # A second folder of the SAME model (a later round) hits the cache:
+    # same kernel object, no new compiles, no retraces — cohort 5 and
+    # cohort 6 both bucket to B=8.
+    dev2 = _run_fold(shapes, _updates("topk8", n=6, seed=1400),
+                     device=True, backend="xla")
+    assert dev2._kernel is kernel
+    assert kernel.compiles == compiles_after_first
+    assert kernel.recompiles == 0
+
+
+def test_kernel_cache_keys_on_slot_fingerprint():
+    prev = os.environ.get("COLEARN_FOLD_BACKEND")
+    os.environ["COLEARN_FOLD_BACKEND"] = "native"
+    try:
+        a = fold_kernel.get_kernel([16, 8])
+        assert fold_kernel.get_kernel((16, 8)) is a
+        assert fold_kernel.get_kernel([16, 9]) is not a
+    finally:
+        if prev is None:
+            os.environ.pop("COLEARN_FOLD_BACKEND", None)
+        else:
+            os.environ["COLEARN_FOLD_BACKEND"] = prev
+
+
+# ------------------------------------------------------------ ownership ----
+@pytest.mark.parametrize("device", [False, True])
+def test_read_only_partial_is_copied_at_staging(device):
+    shapes = _params()
+    base = jax.tree.map(lambda w: np.ones(w.shape, np.float32), shapes)
+    for leaf in jax.tree.leaves(base):
+        leaf.setflags(write=False)
+    snapshot = _tree_bytes(base)
+
+    updates = _updates("topk", n=2, seed=1500)
+    f = _run_fold(shapes, updates, device=device, backend="native",
+                  partials=[("agg:0", 1.0, base, 0.0)])
+    # The fold scattered IN PLACE onto the staged partial — but staging
+    # owned (copied) the read-only leaves, so the caller's tree is
+    # untouched.
+    assert _tree_bytes(base) == snapshot
+    host = _run_fold(shapes, updates,
+                     partials=[("agg:0", 1.0, base, 0.0)])
+    _assert_folds_equal(host, f)

@@ -680,6 +680,33 @@ def test_cl012_suppression(tmp_path):
     assert res.findings == [] and res.suppressed == 1
 
 
+def test_cl012_device_fold_region_stays_gather_free(tmp_path):
+    # The PR-19 device-fold block (`_fold_block_device`, `# colearn: hot`)
+    # retired aggregation.py's last CL012 noqa: staging owns each leaf
+    # with a PER-LEAF asarray loop, and the fold itself runs on slots.
+    # Pin both directions so the region cannot quietly regress into the
+    # full-tree-gather idiom the noqa used to excuse.
+    res = run_lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        def _fold_block_device(self, ids):  # colearn: hot
+            leaves, treedef = jax.tree.flatten(self.acc)
+            owned = [np.asarray(leaf) * self.w for leaf in leaves]
+            return jax.tree.unflatten(treedef, owned)
+    """, relpath="pkg/comm/aggregation.py", rules=["CL012"])
+    assert res.findings == []
+    res = run_lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        def _fold_block_device(self, ids):  # colearn: hot
+            host = jax.tree.map(np.asarray, self.acc)
+            return self.kernel.fold(host)
+    """, relpath="pkg/comm/aggregation.py", rules=["CL012"])
+    assert rule_ids(res) == ["CL012"]
+
+
 def test_cl013_flags_decompress_in_hot_aggregation_path(tmp_path):
     res = run_lint(tmp_path, """
         from pkg.fed import compression

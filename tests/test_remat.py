@@ -53,23 +53,41 @@ def test_remat_is_numerically_identical():
         # Identical param pytree (checkpoints/wire payloads compatible).
         assert jax.tree.structure(p0) == jax.tree.structure(p1)
         np.testing.assert_allclose(float(v0), float(v1), rtol=1e-6)
+        # Tight allclose, not bitwise: jax.checkpoint replays each
+        # block's forward inside the backward pass, and XLA:CPU fuses /
+        # reorders the recomputed reductions differently from the stored
+        # activations (observed max |diff| ~3e-6 on these widths).  The
+        # math is the same; the summation order is not.
         for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       atol=1e-6)
+                                       atol=1e-5, rtol=1e-5)
 
 
 def test_remat_trains_in_engine():
-    cfg = ExperimentConfig(
-        data=DataConfig(dataset="agnews_tiny", num_clients=4, partition="iid",
-                        max_examples_per_client=16),
-        model=ModelConfig(name="bert", num_classes=4, width=32, depth=2,
-                          num_heads=4, seq_len=64, vocab_size=2000,
-                          remat=True),
-        fed=FedConfig(strategy="fedavg", rounds=2, cohort_size=0,
-                      local_steps=2, batch_size=4, lr=0.05, momentum=0.9),
-        run=RunConfig(name="remat_test"),
-    )
-    learner = FederatedLearner(cfg)
-    hist = learner.fit(rounds=2)
-    assert np.isfinite(hist[-1]["train_loss"])
-    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+    # Remat must not CHANGE training — so the pin is trajectory parity
+    # against the non-remat engine, not a loss-goes-down heuristic (two
+    # rounds of this tiny config land wherever the lr schedule takes
+    # them, remat or not; both arms see the identical trajectory).
+    def run(remat):
+        cfg = ExperimentConfig(
+            data=DataConfig(dataset="agnews_tiny", num_clients=4,
+                            partition="iid", max_examples_per_client=16),
+            model=ModelConfig(name="bert", num_classes=4, width=32, depth=2,
+                              num_heads=4, seq_len=64, vocab_size=2000,
+                              remat=remat),
+            fed=FedConfig(strategy="fedavg", rounds=2, cohort_size=0,
+                          local_steps=2, batch_size=4, lr=0.05, momentum=0.9),
+            run=RunConfig(name="remat_test"),
+        )
+        return FederatedLearner(cfg).fit(rounds=2)
+
+    hist_remat = run(True)
+    hist_plain = run(False)
+    assert len(hist_remat) == len(hist_plain)
+    for r_rm, r_pl in zip(hist_remat, hist_plain):
+        assert np.isfinite(r_rm["train_loss"])
+        # Tight allclose, not exact: XLA:CPU reorders the recomputed
+        # reductions under jax.checkpoint (see test above), and the ulp
+        # drift compounds over local steps.
+        np.testing.assert_allclose(r_rm["train_loss"], r_pl["train_loss"],
+                                   rtol=1e-4)
