@@ -20,12 +20,19 @@ from __future__ import annotations
 # ---------------------------------------------------------------- catalog --
 # Counters -----------------------------------------------------------------
 COUNTERS = (
-    # checkpoint plane (ckpt/manager.py, ckpt/wal.py)
+    # checkpoint plane (ckpt/manager.py, ckpt/wal.py, ckpt/streaming.py)
     "ckpt.saves_total",
     "ckpt.restores_total",
     "ckpt.wal_appends_total",
     "ckpt.wal_torn_tail_total",            # in-flight append lost to a kill
     "ckpt.wal_uncommitted_discarded_total",  # logged rounds past the ckpt
+    "ckpt.shards_written_total",           # streaming per-shard files committed
+    "ckpt.save_aborted_total",             # save ended before manifest commit
+    "ckpt.resharded_resumes_total",        # restore re-cut onto a different tp
+    # torn/missing/CRC-bad generations skipped by streaming recovery;
+    # labeled {reason=missing_manifest|torn_manifest|missing_shard|
+    # torn_shard|crc_mismatch}
+    "ckpt.generations_discarded_total",
     # engine plane (fed/engine.py, fed/local.py)
     "engine.rounds_total",
     "local.trainers_built",

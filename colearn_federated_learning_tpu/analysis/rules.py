@@ -1,4 +1,4 @@
-"""The colearn rule set (CL001–CL021).
+"""The colearn rule set (CL001–CL023).
 
 Each rule is ~30 lines: subclass :class:`~.engine.Rule`, set ``id`` /
 ``title`` / ``hint``, yield :class:`~.findings.Finding` objects from
@@ -1228,3 +1228,65 @@ class UnlockedIteration(Rule):
                 and expr.func.attr in self._VIEW_TAILS):
             return lock_regions.self_attr(expr.func.value)
         return None
+
+
+# ----------------------------------------------------------------- CL023 --
+@register
+class NonDurableCheckpointWrite(NonAtomicExchangeWrite):
+    """CL008 keeps exchange READERS from seeing torn files (tmp +
+    ``os.replace``); the durable-state plane — ckpt/ generations and the
+    fed/offline.py exchange root — must also survive POWER LOSS.  A
+    rename without an fsync can reach the directory before the data
+    blocks do, so a crash leaves a complete-looking file of stale or
+    zero bytes that passes every existence check and fails on read.
+    Every durable write must fsync the temp file BEFORE the rename (the
+    ckpt/streaming._atomic_write / utils.serialization.
+    atomic_save_pytree_npz discipline)."""
+
+    id = "CL023"
+    title = "durable-state write without fsync-before-rename"
+    hint = ("route the write through an atomic helper (ckpt/streaming."
+            "_atomic_write, utils.serialization.atomic_save_pytree_npz) "
+            "or add os.fsync before the os.replace in the same function; "
+            "mark a justified non-durable write with "
+            "`# colearn: noqa(CL023)`")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        in_offline = ctx.in_dir("fed") and ctx.parts[-1] == "offline.py"
+        if not (ctx.in_dir("ckpt") or in_offline):
+            return
+        enclosing = _enclosing_functions(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            writer = self._is_writer(node)
+            if writer is None:
+                continue
+            if self._durable(enclosing.get(id(node), ())):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{writer} writes durable state without tmp + fsync + "
+                "os.replace: a crash can surface a torn — or "
+                "complete-looking but stale — file")
+
+    @staticmethod
+    def _durable(fns: tuple) -> bool:
+        """True when an enclosing function either performs the full
+        fsync-then-replace dance itself or hands the bytes to an
+        ``*atomic*``-named helper that owns it."""
+        for fn in fns:
+            replaced = synced = False
+            for inner in ast.walk(fn):
+                if not isinstance(inner, ast.Call):
+                    continue
+                dotted = dotted_name(inner.func)
+                if "atomic" in dotted.rsplit(".", 1)[-1]:
+                    return True
+                if dotted == "os.replace":
+                    replaced = True
+                elif dotted == "os.fsync":
+                    synced = True
+            if replaced and synced:
+                return True
+        return False

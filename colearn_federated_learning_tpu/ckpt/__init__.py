@@ -1,4 +1,9 @@
 from colearn_federated_learning_tpu.ckpt.manager import RoundCheckpointer
+from colearn_federated_learning_tpu.ckpt.streaming import (
+    StreamingCheckpointer,
+    load_generation_host,
+)
 from colearn_federated_learning_tpu.ckpt.wal import EnrollmentLedger, RoundWal
 
-__all__ = ["RoundCheckpointer", "RoundWal", "EnrollmentLedger"]
+__all__ = ["RoundCheckpointer", "StreamingCheckpointer",
+           "load_generation_host", "RoundWal", "EnrollmentLedger"]

@@ -146,6 +146,12 @@ def _add_override_flags(p: argparse.ArgumentParser) -> None:
                    help="mirror scalar round metrics to TensorBoard")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--ckpt-stream", action="store_true", default=None,
+                   help="shard-native streaming checkpoints "
+                        "(ckpt/streaming.py): per-shard CRC-checked "
+                        "files + a manifest commit marker fsynced last; "
+                        "--resume re-shards onto the current mesh "
+                        "without assembling the full tree")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of rounds 1-2 here")
     p.add_argument("--trace-dir", default=None,
@@ -291,7 +297,7 @@ _RUN_KEYS = {"backend", "seed", "tp_size", "eval_every", "log_every",
              "comm_backoff_base", "comm_backoff_max", "fault_plan",
              "fault_seed", "num_aggregators", "agg_heartbeat_timeout",
              "agg_buffer_interval_s", "health_dir", "learn_observe",
-             "fold_device"}
+             "fold_device", "ckpt_stream"}
 
 
 def config_from_args(args: argparse.Namespace) -> ExperimentConfig:
@@ -548,11 +554,25 @@ def _coordinator_resume(coord) -> None:
     except FileNotFoundError:
         print(json.dumps({"event": "resume_cold"}), file=sys.stderr)
         return
-    print(json.dumps({
+    reg = telemetry.get_registry()
+    event = {
         "event": "resumed", "round": step,
-        "rounds_resumed_total": telemetry.get_registry().counter(
+        "rounds_resumed_total": reg.counter(
             "fed.rounds_resumed_total").value,
-    }), file=sys.stderr)
+    }
+    ckpt = getattr(coord, "_ckpt", None)
+    digest = getattr(ckpt, "last_restore_digest", None)
+    if digest is not None:
+        # Streaming restore: the digest is over the full-leaf host bytes
+        # in flatten order, so it is tp-layout-independent — the chaos
+        # harness compares it against load_generation_host's digest of
+        # the generation it expects to survive the kill.
+        event["ckpt_digest"] = digest
+        event["ckpt_discarded"] = sum(
+            getattr(ckpt, "generations_discarded", {}).values())
+        event["resharded"] = reg.counter(
+            "ckpt.resharded_resumes_total").value
+    print(json.dumps(event), file=sys.stderr)
 
 
 def _async_buffer_arg(value: str):
@@ -723,7 +743,10 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     coordinator, which must come back with --resume (faults/procsoak).
     ``--secure``: DH secure-aggregation federation vs a plain-FedAvg
     oracle in lockstep, maskers dropped after-fold/before-unmask; exact
-    per-round param agreement is the gate (faults/soak.run_secure_soak)."""
+    per-round param agreement is the gate (faults/soak.run_secure_soak).
+    ``--ckpt``: streaming-checkpoint crash consistency — SIGKILL lands
+    mid-save, --resume restores the last committed generation bitwise
+    across a tp=2 -> tp=1 re-shard (faults/procsoak.run_ckpt_soak)."""
     if args.secure and args.mp:
         print("--secure is an in-process exactness gate; drop --mp",
               file=sys.stderr)
@@ -741,11 +764,56 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print("--tree-async is its own multi-process gate; "
               "drop --secure/--mp/--agg/--async", file=sys.stderr)
         return 2
+    if args.ckpt and (args.secure or args.mp or args.agg
+                      or args.chaos_async or args.chaos_tree_async):
+        print("--ckpt is its own multi-process gate; "
+              "drop --secure/--mp/--agg/--async/--tree-async",
+              file=sys.stderr)
+        return 2
     if args.lock_witness and not (args.chaos_async
                                   or args.chaos_tree_async):
         print("--lock-witness instruments the buffered-async fleets; "
               "pair it with --async or --tree-async", file=sys.stderr)
         return 2
+    if args.ckpt:
+        from colearn_federated_learning_tpu.faults import procsoak
+
+        summary = procsoak.run_ckpt_soak(
+            rounds=args.rounds, n_workers=args.num_workers,
+            workdir=args.workdir, round_timeout=args.mp_round_timeout,
+            timeout_s=args.mp_timeout, kill=not args.no_faults,
+            log_fn=lambda rec: print(json.dumps(rec), file=sys.stderr),
+        )
+        print(json.dumps(summary))
+        if summary["mode"] == "smoke":
+            # Kill-free bitwise smoke: a tp=2 run's final generation must
+            # resume bitwise-identically on tp=1 (digest match across the
+            # re-shard, no kill involved).
+            ok = (summary["exit_code"] == 0
+                  and summary["resume_exit_code"] == 0
+                  and summary["rounds_run"] >= args.rounds
+                  and summary["resume_round_ok"]
+                  and summary["digest_ok"]
+                  and summary["reshard_ok"])
+        else:
+            # SIGKILL-during-save gate: the kill landed mid-save, the
+            # resume fell back to the last COMMITTED generation (at most
+            # one uncommitted generation lost) and restored it bitwise
+            # across the tp=2 -> tp=1 re-shard, the federation finished
+            # with loss parity vs the kill-free oracle, and the
+            # postmortem attributes the kill.
+            ok = (summary["exit_code"] == 0
+                  and summary["oracle_exit_code"] == 0
+                  and summary["rounds_run"] >= args.rounds
+                  and summary["killed_mid_save"]
+                  and summary["resumed"] >= 1
+                  and summary["resume_round_ok"]
+                  and summary["digest_ok"]
+                  and summary["reshard_ok"]
+                  and summary["loss_gap_ok"]
+                  and summary["postmortem_attributed"]
+                  and not summary["flight_missing"])
+        return 0 if ok else 1
     if args.chaos_tree_async:
         from colearn_federated_learning_tpu.faults import procsoak
 
@@ -1498,6 +1566,17 @@ def main(argv: list[str] | None = None) -> int:
                               "tail-loss parity vs a same-seed kill-free "
                               "tree oracle "
                               "(faults/procsoak.run_tree_async_soak)")
+    p_chaos.add_argument("--ckpt", action="store_true",
+                         help="streaming-checkpoint chaos gate: a tp=2 "
+                              "--ckpt-stream federation is SIGKILLed "
+                              "mid-save (shard files down, manifest not "
+                              "yet committed) and must --resume on tp=1 "
+                              "from the last COMMITTED generation, "
+                              "bitwise (digest match across the "
+                              "re-shard), with loss parity vs a "
+                              "kill-free oracle; with --no-faults runs "
+                              "the kill-free cross-tp bitwise smoke "
+                              "(faults/procsoak.run_ckpt_soak)")
     p_chaos.add_argument("--lock-witness", action="store_true",
                          help="(--async/--tree-async) run every fleet "
                               "process with the runtime lock witness "

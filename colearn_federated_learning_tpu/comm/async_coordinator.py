@@ -1569,12 +1569,18 @@ class AsyncFederatedCoordinator:
         protocol.pop_trace_spans(meta, self.tracer)
         return meta
 
-    # ---- checkpoint/resume (same RoundCheckpointer as the engine) --------
+    # ---- checkpoint/resume (same RoundCheckpointer as the engine, or the
+    # shard-native StreamingCheckpointer when run.ckpt_stream is set) ------
     def _checkpointer(self):
         if self._ckpt is None:
-            from colearn_federated_learning_tpu.ckpt import RoundCheckpointer
+            from colearn_federated_learning_tpu.ckpt import (
+                RoundCheckpointer,
+                StreamingCheckpointer,
+            )
 
-            self._ckpt = RoundCheckpointer.for_run(self.config.run)
+            cls = (StreamingCheckpointer if self.config.run.ckpt_stream
+                   else RoundCheckpointer)
+            self._ckpt = cls.for_run(self.config.run)
         return self._ckpt
 
     def save_checkpoint(self) -> None:

@@ -1634,11 +1634,14 @@ class FederatedCoordinator:
                 "per-client evaluation is disabled under secure_agg: "
                 "per-client statistics are exactly what the masks hide"
             )
+        from colearn_federated_learning_tpu.comm.downlink import host_params
         from colearn_federated_learning_tpu.utils.serialization import (
             pytree_to_bytes,
         )
 
-        params_np = jax.tree.map(np.asarray, self._eval_params())
+        # Per-shard host read (no full-tree gather): counts the avoided
+        # bytes into ``comm.gather_bytes_avoided_total``.
+        params_np = host_params(self._eval_params())
         # Serialize-once here too: one shared frame for the whole fan-out.
         body = memoryview(pytree_to_bytes(params_np))
         telemetry.get_registry().counter("comm.broadcast_encode_total").inc()
@@ -1682,7 +1685,9 @@ class FederatedCoordinator:
         """Score the global model on the evaluator device (SURVEY.md §3d)."""
         if self.evaluator is None:
             raise RuntimeError("no evaluator was assigned")
-        params_np = jax.tree.map(np.asarray, self._eval_params())
+        from colearn_federated_learning_tpu.comm.downlink import host_params
+
+        params_np = host_params(self._eval_params())
         with self.tracer.span("evaluate"):
             header, _ = self._clients[self.evaluator.device_id].request(
                 protocol.attach_trace({"op": "eval"},
@@ -1695,12 +1700,18 @@ class FederatedCoordinator:
         _pop_worker_spans(meta, self.tracer)
         return meta
 
-    # ---- checkpoint/resume (same RoundCheckpointer as the engine) --------
+    # ---- checkpoint/resume (same RoundCheckpointer as the engine, or the
+    # shard-native StreamingCheckpointer when run.ckpt_stream is set) ------
     def _checkpointer(self):
         if self._ckpt is None:
-            from colearn_federated_learning_tpu.ckpt import RoundCheckpointer
+            from colearn_federated_learning_tpu.ckpt import (
+                RoundCheckpointer,
+                StreamingCheckpointer,
+            )
 
-            self._ckpt = RoundCheckpointer.for_run(self.config.run)
+            cls = (StreamingCheckpointer if self.config.run.ckpt_stream
+                   else RoundCheckpointer)
+            self._ckpt = cls.for_run(self.config.run)
         return self._ckpt
 
     def _round_wal(self):

@@ -23,6 +23,18 @@ dropout protocol exists for).  File/hierarchical-plane kinds (applied by
 - ``stale_round``   — an update carries an old round stamp (silo replay);
 - ``drop_silo``     — a silo/group's contribution never arrives.
 
+Checkpoint-plane kinds (applied by :mod:`.fileplane`'s ``ckpt_*`` hooks
+inside ``ckpt/streaming.py`` saves, keyed ``(shard, generation, op)``
+with ``hop`` carrying the op — ``shard`` | ``history`` | ``manifest``):
+
+- ``torn_shard``     — a committed shard file is cut to half its bytes
+  (the torn artifact recovery must fall back over);
+- ``stale_manifest`` — the generation's manifest write is suppressed, so
+  the save aborts uncommitted (a SIGKILL between the last shard fsync
+  and the manifest replace);
+- ``slow_io``        — the shard/manifest write sleeps ``ms`` first (the
+  deterministic window the kill-during-save chaos gate fires into).
+
 JSON surface (``--fault-plan plan.json``)::
 
     {"seed": 7, "faults": [
@@ -44,9 +56,12 @@ import zlib
 from typing import Optional
 
 KINDS = ("drop_request", "delay", "corrupt_payload", "crash_worker",
-         "flap_reconnect", "truncate_file", "stale_round", "drop_silo")
+         "flap_reconnect", "truncate_file", "stale_round", "drop_silo",
+         "torn_shard", "stale_manifest", "slow_io")
 
 FILE_KINDS = ("truncate_file", "stale_round", "drop_silo")
+
+CKPT_KINDS = ("torn_shard", "stale_manifest", "slow_io")
 
 ANY = "*"          # wildcard device_id / op
 ANY_ROUND = -1     # wildcard round

@@ -333,6 +333,11 @@ class RunConfig:
     eval_every: int = 1
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0         # 0 disables
+    # Shard-native streaming checkpoints (ckpt/streaming.py): per-shard
+    # CRC-checked files + a manifest commit marker fsynced last, restore
+    # re-shards onto the current mesh without full-tree assembly.  False
+    # keeps the orbax RoundCheckpointer path byte-identical to before.
+    ckpt_stream: bool = False
     profile_dir: Optional[str] = None  # jax.profiler trace output (rounds 1-2)
     trace_dir: Optional[str] = None    # span-trace Chrome JSON output dir
     trace_rounds: int = 0              # trace only the first N rounds (0 = all)

@@ -30,6 +30,14 @@ the bench CNN shape and measures, per round:
   device row breaks parity or the batched topk8 device fold is slower
   than the host.
 
+- the CKPT sweep (``--ckpt-tp``): durable save/restore wall-clock at
+  real BERT-base weights, one ``wire_ckpt`` row per path — the
+  shard-native ``StreamingCheckpointer`` (per-shard CRC-checked files,
+  manifest-last commit, no host gather; the ``ckpt-save-no-gather``
+  sentinel gates its measured ``gather_avoided``) vs the gathered flat
+  ``RoundCheckpointer`` (host-materialize, then orbax) — both restores
+  verified bitwise against the source weights.
+
 With ``--fold-device`` the wire rounds themselves ingest through the
 device kernel (``fold_device_folds_per_round`` must equal the cohort or
 the run fails).  ``--check-schema`` validates every emitted row against
@@ -162,10 +170,31 @@ FOLD_ROW_SCHEMA = {
     "bench_wall_s": float,
 }
 
+# Checkpoint save/restore rows (--ckpt-tp): wall-clock for a full
+# BERT-base durable save and restore, the shard-native streaming path
+# (per-shard files, no host gather — the ckpt-save-no-gather sentinel
+# gates its gather_avoided) vs the gathered flat path.
+CKPT_ROW_SCHEMA = {
+    "bench": str,
+    "model": str,
+    "path": str,
+    "tp_size": int,
+    "repeats": int,
+    "param_count": int,
+    "param_bytes": int,
+    "save_s": float,
+    "restore_s": float,
+    "gather_avoided": int,
+    "shards_per_gen": int,
+    "restore_bitwise": bool,
+    "bench_wall_s": float,
+}
+
 SCHEMAS = {
     "wire_round": ROW_SCHEMA,
     "wire_lora": LORA_ROW_SCHEMA,
     "wire_fold": FOLD_ROW_SCHEMA,
+    "wire_ckpt": CKPT_ROW_SCHEMA,
 }
 
 
@@ -616,6 +645,122 @@ def run_fold_rows(frame: str, cohort: int, repeats: int,
     return rows
 
 
+def run_ckpt_rows(tp_size: int, repeats: int) -> list[dict]:
+    """Durable save/restore wall-clock at real BERT-base weights: the
+    shard-native streaming path (each device shard writes its own
+    CRC-checked file, manifest committed last, NO host gather) vs the
+    gathered flat path (host-materialize the full tree, then the orbax
+    ``RoundCheckpointer``).  Both restores are verified bitwise against
+    the source weights via the streaming digest recipe."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from colearn_federated_learning_tpu.ckpt import (
+        RoundCheckpointer,
+        StreamingCheckpointer,
+    )
+    from colearn_federated_learning_tpu.ckpt.streaming import _digest_update
+    from colearn_federated_learning_tpu.models import registry as models
+    from colearn_federated_learning_tpu.parallel import partition
+    from colearn_federated_learning_tpu.utils.config import get_config
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.time()
+    bert_cfg = get_config("agnews_bert_fedavg").model
+    model = models.build_model(bert_cfg)
+    shape_tree = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, bert_cfg.seq_len), jnp.int32),
+                             train=False),
+        jax.random.PRNGKey(0))["params"]
+    rng = np.random.default_rng(23)
+    params = jax.tree.map(
+        lambda l: rng.standard_normal(l.shape).astype(l.dtype), shape_tree)
+    param_count = sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    param_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree.leaves(params))
+
+    def digest_of(tree):
+        h = hashlib.sha256()
+        for leaf in jax.tree.leaves(tree):
+            arr = np.asarray(leaf)
+            _digest_update(h, arr.dtype, tuple(arr.shape), arr)
+        return h.hexdigest()
+
+    expected = digest_of(params)
+    reg = telemetry.get_registry()
+
+    placement = partition.make_server_placement(
+        params, tp_size, "model", bert_cfg.name)
+    if placement is None:
+        raise SystemExit(
+            f"FAIL: no server placement at tp_size={tp_size} "
+            "(ckpt bench needs a sharded tree to price)")
+    sharded = placement.shard(params)
+    template = jax.tree.map(np.zeros_like, params)
+
+    def row(path, save_s, restore_s, gather_avoided, shards, restored):
+        return {
+            "bench": "wire_ckpt",
+            "model": "bert-base",
+            "path": path,
+            "tp_size": tp_size if path == "sharded" else 1,
+            "repeats": repeats,
+            "param_count": param_count,
+            "param_bytes": int(param_bytes),
+            "save_s": round(save_s, 4),
+            "restore_s": round(restore_s, 4),
+            "gather_avoided": int(gather_avoided),
+            "shards_per_gen": shards,
+            "restore_bitwise": digest_of(restored) == expected,
+            "bench_wall_s": round(time.time() - t0, 1),
+        }
+
+    rows = []
+    # --- streaming sharded leg -------------------------------------------
+    stream_dir = tempfile.mkdtemp(prefix="bench_ckpt_stream_")
+    flat_dir = tempfile.mkdtemp(prefix="bench_ckpt_flat_")
+    try:
+        stream = StreamingCheckpointer(stream_dir, max_to_keep=1)
+        before = reg.counter("comm.gather_bytes_avoided_total").value
+        t = time.perf_counter()
+        for r in range(repeats):
+            stream.save(r + 1, sharded, [])
+        save_s = (time.perf_counter() - t) / repeats
+        avoided = (reg.counter("comm.gather_bytes_avoided_total").value
+                   - before) / repeats
+        gen = os.path.join(stream_dir, f"gen_{repeats:08d}")
+        shards = sum(1 for n in os.listdir(gen) if n.startswith("shard_"))
+        t = time.perf_counter()
+        restored, _, _ = StreamingCheckpointer(stream_dir).restore(template)
+        restore_s = time.perf_counter() - t
+        rows.append(row("sharded", save_s, restore_s, avoided, shards,
+                        restored))
+
+        # --- gathered flat leg -------------------------------------------
+        flat = RoundCheckpointer(flat_dir, max_to_keep=1)
+        t = time.perf_counter()
+        for r in range(repeats):
+            # The gather IS part of the cost being priced: the flat path
+            # must host-materialize the full tree before it can save.
+            host = jax.tree.map(np.asarray, sharded)
+            flat.save(r + 1, host, [])
+        save_s = (time.perf_counter() - t) / repeats
+        t = time.perf_counter()
+        restored, _, _ = flat.restore(template)
+        restore_s = time.perf_counter() - t
+        flat.close()
+        rows.append(row("gathered", save_s, restore_s, 0, 0, restored))
+    finally:
+        shutil.rmtree(stream_dir, ignore_errors=True)
+        shutil.rmtree(flat_dir, ignore_errors=True)
+    return rows
+
+
 def check_schema(path: str) -> int:
     """Validate every row of the bench JSONL against the schema for its
     ``bench`` tag (CI gate): required fields present, numerics numeric."""
@@ -698,6 +843,14 @@ def main(argv=None) -> int:
                     help="timed folds per fold-throughput row")
     ap.add_argument("--fold-only", action="store_true",
                     help="run only the --fold-frames sweep (CI wire-smoke)")
+    ap.add_argument("--ckpt-tp", type=int, default=2,
+                    help="server tp_size for the wire_ckpt save/restore "
+                         "rows (sharded streaming vs gathered flat at "
+                         "BERT-base); 0 skips the sweep")
+    ap.add_argument("--ckpt-repeats", type=int, default=2,
+                    help="timed saves per wire_ckpt row")
+    ap.add_argument("--ckpt-only", action="store_true",
+                    help="run only the wire_ckpt rows (CI ckpt-soak)")
     ap.add_argument("--check-schema", action="store_true",
                     help="after the sweep, validate the output JSONL "
                          "against the per-bench row schemas and fail on "
@@ -803,15 +956,41 @@ def main(argv=None) -> int:
                     f"SLOWER than the host fold "
                     f"({row['speedup_vs_host']}x)")
 
-    if args.fold_only:
-        for frame in (s.strip() for s in args.fold_frames.split(",") if s):
-            fold_rows(frame)
+    def ckpt_rows():
+        for row in run_ckpt_rows(args.ckpt_tp, args.ckpt_repeats):
+            rows.append(row)
+            print(json.dumps(row))
+            if not row["restore_bitwise"]:
+                raise SystemExit(
+                    f"FAIL: {row['path']} ckpt restore diverged bitwise "
+                    "from the saved weights")
+            if row["path"] == "sharded" and row["gather_avoided"] < 1:
+                raise SystemExit(
+                    "FAIL: sharded streaming save avoided no gather bytes "
+                    "(the full tree was host-materialized)")
+            if row["path"] == "sharded" and row["shards_per_gen"] < 2:
+                raise SystemExit(
+                    f"FAIL: streaming save wrote "
+                    f"{row['shards_per_gen']} shard file(s) at "
+                    f"tp_size={args.ckpt_tp} (shard-wise layout not "
+                    "engaged)")
+
+    def write_out():
         os.makedirs(os.path.dirname(args.out), exist_ok=True)
         with open(args.out, "w") as f:
             for row in rows:
                 f.write(json.dumps(row) + "\n")
         print(f"wrote {len(rows)} rows to {args.out}")
         return check_schema(args.out) if args.check_schema else 0
+
+    if args.ckpt_only:
+        ckpt_rows()
+        return write_out()
+
+    if args.fold_only:
+        for frame in (s.strip() for s in args.fold_frames.split(",") if s):
+            fold_rows(frame)
+        return write_out()
 
     if not args.lora_only:
         # Downlink matrix (unchanged axes): cohorts × down-schemes × tp.
@@ -843,12 +1022,11 @@ def main(argv=None) -> int:
         for frame in (s.strip() for s in args.fold_frames.split(",") if s):
             fold_rows(frame)
 
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
-        for row in rows:
-            f.write(json.dumps(row) + "\n")
-    print(f"wrote {len(rows)} rows to {args.out}")
-    return check_schema(args.out) if args.check_schema else 0
+    # Durable save/restore at BERT-base: streaming sharded vs flat.
+    if not args.lora_only and args.ckpt_tp > 0:
+        ckpt_rows()
+
+    return write_out()
 
 
 if __name__ == "__main__":

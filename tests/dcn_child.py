@@ -23,10 +23,13 @@ jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
-from jax import shard_map  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from colearn_federated_learning_tpu.utils.jax_compat import (  # noqa: E402
+    shard_map,
+)
 
 from colearn_federated_learning_tpu.fed.engine import (  # noqa: E402
     FederatedLearner,

@@ -1,5 +1,6 @@
 """analysis/: one positive + one suppression fixture per rule
-(CL001–CL016), the noqa/baseline machinery (CL000 dead suppressions,
+(CL001–CL016 and CL023; CL017–CL021 live in test_lint_concurrency.py),
+the noqa/baseline machinery (CL000 dead suppressions,
 line-shift-stable fingerprints), the `colearn lint` CLI exit codes, the
 labeled-counter roll-up the registry grew for per-device attribution,
 and the tier-1 self-check that the installed package is lint-clean."""
@@ -361,7 +362,7 @@ def test_cl008_flags_in_place_exchange_writes_in_fed(tmp_path):
 
         def raw(path, arrs):
             np.savez(path, **arrs)
-    """, relpath="pkg/fed/offline.py")
+    """, relpath="pkg/fed/exchange.py")
     assert rule_ids(res) == ["CL008"]
     assert len(res.findings) == 3
 
@@ -375,7 +376,7 @@ def test_cl008_allows_temp_plus_replace_in_same_function(tmp_path):
             tmp = path + ".tmp"
             save_pytree_npz(tmp, tree)
             os.replace(tmp, path)
-    """, relpath="pkg/fed/offline.py")
+    """, relpath="pkg/fed/exchange.py")
     assert res.findings == []
 
 
@@ -406,7 +407,7 @@ def test_cl008_suppression(tmp_path):
         def scratch(path, blob):
             with open(path, "wb") as f:  # colearn: noqa(CL008): test fixture
                 f.write(blob)
-    """, relpath="pkg/fed/offline.py")
+    """, relpath="pkg/fed/exchange.py")
     assert res.findings == [] and res.suppressed == 1
 
 
@@ -963,6 +964,75 @@ def test_cl016_suppression(tmp_path):
             rec["experimental_key"] = 1  # colearn: noqa(CL016): test fixture
             return rec
     """, relpath="pkg/comm/coordinator.py", rules=["CL016"])
+    assert res.findings == [] and res.suppressed == 1
+
+
+# ------------------------------------------------------------- CL023 ----
+def test_cl023_flags_replace_without_fsync_in_ckpt(tmp_path):
+    # os.replace alone satisfies CL008's torn-reader contract but not
+    # CL023's power-loss one: the rename can land before the data blocks.
+    res = run_lint(tmp_path, """
+        import os
+
+        def commit(path, body):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.replace(tmp, path)
+    """, relpath="pkg/ckpt/gen.py", rules=["CL023"])
+    assert rule_ids(res) == ["CL023"]
+
+
+def test_cl023_flags_in_place_npz_in_offline(tmp_path):
+    res = run_lint(tmp_path, """
+        import numpy as np
+
+        def export(path, arrays):
+            np.savez(path, **arrays)
+    """, relpath="pkg/fed/offline.py", rules=["CL023"])
+    assert rule_ids(res) == ["CL023"]
+
+
+def test_cl023_passes_fsync_before_replace_and_atomic_helper(tmp_path):
+    res = run_lint(tmp_path, """
+        import os
+        import numpy as np
+        from pkg.utils.serialization import atomic_save_pytree_npz
+
+        def commit(path, body):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(body)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+        def shard_write(path, buffers):
+            _atomic_write(path, lambda f: np.savez(f, **buffers))
+
+        def export(path, tree):
+            atomic_save_pytree_npz(path, tree)
+    """, relpath="pkg/ckpt/streaming.py", rules=["CL023"])
+    assert res.findings == []
+
+
+def test_cl023_only_applies_to_durable_paths(tmp_path):
+    # The same in-place write outside ckpt/ and fed/offline.py is CL008's
+    # (or nobody's) business, not CL023's.
+    res = run_lint(tmp_path, """
+        def scratch(path, body):
+            with open(path, "w") as f:
+                f.write(body)
+    """, relpath="pkg/comm/mod.py", rules=["CL023"])
+    assert res.findings == []
+
+
+def test_cl023_suppression(tmp_path):
+    res = run_lint(tmp_path, """
+        def scratch(path, body):
+            with open(path, "w") as f:  # colearn: noqa(CL023): test fixture
+                f.write(body)
+    """, relpath="pkg/ckpt/tmp.py", rules=["CL023"])
     assert res.findings == [] and res.suppressed == 1
 
 
